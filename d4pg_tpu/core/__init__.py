@@ -1,0 +1,33 @@
+"""Pure-functional core: distributional ops, losses, target updates, noise.
+
+Everything here is shape-polymorphic, jit-able, and PRNG-key-threaded. No
+mutable state, no host round-trips — this layer is what compiles onto the TPU.
+"""
+
+from d4pg_tpu.core.distribution import (
+    CategoricalSupport,
+    categorical_projection,
+    projection_weights,
+)
+from d4pg_tpu.core.losses import (
+    categorical_td_loss,
+    expected_q,
+    policy_loss,
+)
+from d4pg_tpu.core.noise import GaussianNoiseState, OUNoiseState, gaussian, ou
+from d4pg_tpu.core.updates import hard_update, soft_update
+
+__all__ = [
+    "CategoricalSupport",
+    "categorical_projection",
+    "projection_weights",
+    "categorical_td_loss",
+    "expected_q",
+    "policy_loss",
+    "GaussianNoiseState",
+    "OUNoiseState",
+    "gaussian",
+    "ou",
+    "hard_update",
+    "soft_update",
+]

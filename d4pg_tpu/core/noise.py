@@ -1,0 +1,129 @@
+"""Exploration noise as pure PRNG-key-threaded functions with explicit state.
+
+Parity: the reference's ``GaussianNoise`` and ``OrnsteinUhlenbeckProcess``
+(``random_process.py:4-21`` and ``:23-45``): epsilon-scaled noise with an
+exponential decay schedule ``eps = min_eps + (1 - min_eps) * exp(-decay * k)``
+advanced on episode reset, where ``decay = 5 / horizon``.
+
+TPU-first differences:
+  - stateless sampling from ``jax.random`` keys instead of the global numpy
+    RNG, so per-actor streams are decorrelated by key-splitting and runs are
+    reproducible;
+  - state (epsilon counter, OU mean-reverting x) is an explicit pytree that
+    can be vmapped over a batch of environments and carried through
+    ``lax.scan`` rollouts.
+
+Reference quirks deliberately NOT reproduced (documented divergence):
+  - ``GaussianNoise.reset`` never increments its counter
+    (``random_process.py:19-21``), so a reset would *raise* epsilon from the
+    initial 0.3 to 1.0; and the live loop never calls ``reset()`` anyway
+    (call commented at ``main.py:366``), freezing epsilon at 0.3. Here the
+    decay schedule actually runs, starting from the same eps_0 = 0.3.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def _epsilon(k: Array, min_epsilon: float, decay_rate: float) -> Array:
+    return min_epsilon + (1.0 - min_epsilon) * jnp.exp(-decay_rate * k)
+
+
+class GaussianNoiseState(NamedTuple):
+    """State of epsilon-decayed Gaussian action noise."""
+
+    epsilon: Array  # scalar (or [num_envs]) current scale
+    resets: Array  # int32 reset counter driving the decay
+
+
+class gaussian:
+    """eps * N(mu, sigma^2) per action dim (``random_process.py:4-21``)."""
+
+    @staticmethod
+    def init(
+        horizon: int = 5000, epsilon_0: float = 0.3, batch_shape: tuple = ()
+    ) -> GaussianNoiseState:
+        del horizon  # decay rate is recomputed in reset(); kept for symmetry
+        return GaussianNoiseState(
+            epsilon=jnp.full(batch_shape, epsilon_0, dtype=jnp.float32),
+            resets=jnp.zeros(batch_shape, dtype=jnp.int32),
+        )
+
+    @staticmethod
+    def sample(
+        state: GaussianNoiseState,
+        key: Array,
+        shape: tuple,
+        mu: float = 0.0,
+        sigma: float = 1.0,
+    ) -> Array:
+        eps = jnp.reshape(state.epsilon, state.epsilon.shape + (1,) * (len(shape) - state.epsilon.ndim))
+        return eps * (mu + sigma * jax.random.normal(key, shape))
+
+    @staticmethod
+    def reset(
+        state: GaussianNoiseState,
+        horizon: int = 5000,
+        min_epsilon: float = 0.01,
+    ) -> GaussianNoiseState:
+        """Advance the decay schedule by one episode."""
+        k = state.resets + 1
+        return GaussianNoiseState(
+            epsilon=_epsilon(k.astype(jnp.float32), min_epsilon, 5.0 / horizon),
+            resets=k,
+        )
+
+
+class OUNoiseState(NamedTuple):
+    """State of an Ornstein-Uhlenbeck process with epsilon decay."""
+
+    x: Array  # [..., act_dim] mean-reverting state
+    epsilon: Array  # scalar (or [...]) scale
+    resets: Array  # int32 reset counter
+
+
+class ou:
+    """Temporally correlated OU noise (``random_process.py:23-45``):
+    ``x += theta * (mu - x) * dt + sigma * sqrt(dt) * N(0, I)``, scaled by a
+    decaying epsilon."""
+
+    @staticmethod
+    def init(act_dim: int, batch_shape: tuple = (), epsilon_0: float = 1.0) -> OUNoiseState:
+        return OUNoiseState(
+            x=jnp.zeros(batch_shape + (act_dim,), dtype=jnp.float32),
+            epsilon=jnp.full(batch_shape, epsilon_0, dtype=jnp.float32),
+            resets=jnp.zeros(batch_shape, dtype=jnp.int32),
+        )
+
+    @staticmethod
+    def sample(
+        state: OUNoiseState,
+        key: Array,
+        theta: float = 0.25,
+        mu: float = 0.0,
+        sigma: float = 0.05,
+        dt: float = 0.01,
+    ) -> tuple[OUNoiseState, Array]:
+        x = state.x + theta * (mu - state.x) * dt + sigma * jnp.sqrt(
+            jnp.asarray(dt)
+        ) * jax.random.normal(key, state.x.shape)
+        eps = state.epsilon[..., None]
+        return state._replace(x=x), eps * x
+
+    @staticmethod
+    def reset(
+        state: OUNoiseState, horizon: int = 5000, min_epsilon: float = 0.01
+    ) -> OUNoiseState:
+        """Zero the process and advance the epsilon decay
+        (``random_process.py:41-45``)."""
+        k = state.resets + 1
+        return OUNoiseState(
+            x=jnp.zeros_like(state.x),
+            epsilon=_epsilon(k.astype(jnp.float32), min_epsilon, 5.0 / horizon),
+            resets=k,
+        )

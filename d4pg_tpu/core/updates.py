@@ -1,0 +1,29 @@
+"""Target-network update rules as pytree transforms.
+
+Parity: the reference's per-parameter soft update
+``theta' <- (1 - tau) * theta' + tau * theta`` (``ddpg.py:110-116``) and hard
+update / state_dict copy (``ddpg.py:92-94``). Here these are pure pytree maps
+that live *inside* the jit'd learner step — no parameter iteration on the
+host, no data movement.
+"""
+
+from __future__ import annotations
+
+from typing import TypeVar
+
+import jax
+
+T = TypeVar("T")
+
+
+def soft_update(target: T, online: T, tau: float) -> T:
+    """Polyak-averaged target update over arbitrary parameter pytrees."""
+    return jax.tree_util.tree_map(
+        lambda t, o: (1.0 - tau) * t + tau * o, target, online
+    )
+
+
+def hard_update(target: T, online: T) -> T:
+    """Copy online params into the target pytree (``ddpg.py:92-94``)."""
+    del target
+    return jax.tree_util.tree_map(lambda o: o, online)

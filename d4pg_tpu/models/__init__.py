@@ -1,0 +1,21 @@
+"""Flax (Linen) network definitions: actor, distributional critics, encoders."""
+
+from d4pg_tpu.models.init import fanin_init
+from d4pg_tpu.models.actor import Actor
+from d4pg_tpu.models.critic import (
+    CategoricalCritic,
+    MixtureOfGaussianCritic,
+    MoGParams,
+)
+from d4pg_tpu.models.encoder import PixelEncoder, PixelActor, PixelCategoricalCritic
+
+__all__ = [
+    "fanin_init",
+    "Actor",
+    "CategoricalCritic",
+    "MixtureOfGaussianCritic",
+    "MoGParams",
+    "PixelEncoder",
+    "PixelActor",
+    "PixelCategoricalCritic",
+]

@@ -1,0 +1,74 @@
+"""Convolutional pixel encoder and pixel actor/critic wrappers.
+
+The reference has no pixel path, but BASELINE.md config #4 (DM-Control
+cheetah-run from pixels, conv encoder) requires one. This is the standard
+continuous-control conv stack (SAC-AE/DrQ-style): four 3x3 conv layers with
+stride 2 then 1, ReLU, flattened through a linear projection + LayerNorm +
+tanh into a compact latent that feeds the MLP actor/critic.
+
+TPU notes: convs run on the MXU via XLA's conv-as-matmul lowering; NHWC
+layout; channel count 32 keeps im2col tiles well-shaped. The encoder latent
+is the natural place to introduce a ``model`` mesh axis if the trunk is ever
+scaled up (SURVEY.md §2 mesh mandate).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from d4pg_tpu.models.actor import Actor
+from d4pg_tpu.models.critic import CategoricalCritic
+
+
+class PixelEncoder(nn.Module):
+    latent_dim: int = 50
+    channels: Sequence[int] = (32, 32, 32, 32)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, pixels: jnp.ndarray) -> jnp.ndarray:
+        # pixels: [..., H, W, C] uint8 or float
+        x = pixels.astype(self.dtype) / 255.0
+        for i, ch in enumerate(self.channels):
+            stride = 2 if i == 0 else 1
+            x = nn.Conv(
+                ch, (3, 3), strides=(stride, stride), dtype=self.dtype, name=f"conv{i + 1}"
+            )(x)
+            x = nn.relu(x)
+        x = x.reshape(x.shape[: -3] + (-1,))
+        x = nn.Dense(self.latent_dim, dtype=self.dtype, name="proj")(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln")(x)
+        return jnp.tanh(x).astype(jnp.float32)
+
+
+class PixelActor(nn.Module):
+    """Encoder + MLP actor for pixel observations."""
+
+    act_dim: int
+    latent_dim: int = 50
+    hidden: Sequence[int] = (256, 256, 256)
+
+    @nn.compact
+    def __call__(self, pixels: jnp.ndarray) -> jnp.ndarray:
+        z = PixelEncoder(self.latent_dim, name="encoder")(pixels)
+        return Actor(self.act_dim, self.hidden, name="actor")(z)
+
+
+class PixelCategoricalCritic(nn.Module):
+    """Encoder + categorical critic for pixel observations."""
+
+    n_atoms: int = 51
+    latent_dim: int = 50
+    hidden: Sequence[int] = (256, 256, 256)
+
+    @nn.compact
+    def __call__(
+        self, pixels: jnp.ndarray, action: jnp.ndarray, return_logits: bool = False
+    ) -> jnp.ndarray:
+        z = PixelEncoder(self.latent_dim, name="encoder")(pixels)
+        return CategoricalCritic(self.n_atoms, self.hidden, name="critic")(
+            z, action, return_logits
+        )

@@ -1,0 +1,38 @@
+"""Weight initializers.
+
+Parity: the reference's ``fanin_init`` (``models.py:6-9``) draws
+N(0, 1/sqrt(fan_in)) for hidden layers, and the output layers use small
+normal draws — N(0, 3e-3) for the actor head (``models.py:30``) and
+N(0, 3e-4) for the critic head (``models.py:73``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fanin_init(dtype=jnp.float32):
+    """N(0, 1/sqrt(fan_in)) initializer for [fan_in, fan_out] kernels.
+
+    Note the reference's std: torch ``Tensor.normal_(0, v)`` takes a *std* of
+    ``1/sqrt(fanin)`` (``models.py:8-9``) — i.e. variance 1/fanin — which is
+    what we reproduce here.
+    """
+
+    def init(key, shape, dtype=dtype):
+        fan_in = shape[0]
+        return (1.0 / jnp.sqrt(jnp.asarray(fan_in, dtype))) * jax.random.normal(
+            key, shape, dtype
+        )
+
+    return init
+
+
+def scaled_normal(std: float, dtype=jnp.float32):
+    """N(0, std) initializer for output heads (``models.py:30, 73``)."""
+
+    def init(key, shape, dtype=dtype):
+        return std * jax.random.normal(key, shape, dtype)
+
+    return init

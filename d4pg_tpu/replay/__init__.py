@@ -1,0 +1,25 @@
+"""Host-side replay: storage, prioritization, n-step folding, device staging.
+
+Replay lives in TPU-VM host RAM (preallocated numpy arrays, not the
+reference's Python tuple lists, ``replay_memory.py:14-19``), with vectorized
+segment trees for PER sampling and an async host->device staging pipeline so
+batch transfer hides under the XLA learner step.
+"""
+
+from d4pg_tpu.replay.schedule import LinearSchedule
+from d4pg_tpu.replay.uniform import ReplayBuffer, TransitionBatch
+from d4pg_tpu.replay.segment_tree import MinTree, SumTree
+from d4pg_tpu.replay.prioritized import PrioritizedReplayBuffer
+from d4pg_tpu.replay.nstep import NStepFolder
+from d4pg_tpu.replay.staging import DeviceStager
+
+__all__ = [
+    "LinearSchedule",
+    "ReplayBuffer",
+    "TransitionBatch",
+    "SumTree",
+    "MinTree",
+    "PrioritizedReplayBuffer",
+    "NStepFolder",
+    "DeviceStager",
+]

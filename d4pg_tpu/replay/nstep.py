@@ -1,0 +1,128 @@
+"""N-step transition folding at insert time.
+
+The reference ships n-step machinery only as dead code (the commented-out
+warmup in ``replay_memory.py:21-58`` and ``main.py:209-242``; ``--n_steps``
+otherwise only scales the discount of the *unused* projection,
+``ddpg.py:24,129``). SURVEY.md §7 capability 5 mandates a real
+implementation. This folder maintains a sliding window of the last n
+transitions per environment and emits folded transitions
+
+    (s_t, a_t, R_t^{(m)} = sum_{k<m} gamma^k r_{t+k}, s_{t+m}, done, disc)
+
+with ``disc = gamma^m * (1 - done)`` baked in, so the learner's Bellman
+backup is simply ``R + disc * Z(s')`` regardless of n, terminal truncation,
+or partial tails at episode end:
+
+  - a full window emits the oldest entry with m = n,
+  - termination (``done``) flushes every pending entry with done=1, disc=0,
+  - time-limit truncation flushes with done=0, disc=gamma^m so the value
+    bootstraps (a semantic the reference conflates by treating
+    ``info['is_success']`` as done, ``main.py:148``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from d4pg_tpu.replay.uniform import TransitionBatch
+
+
+class NStepFolder:
+    def __init__(self, n: int, gamma: float, num_envs: int, obs_dim: int, act_dim: int):
+        assert n >= 1
+        self.n = int(n)
+        self.gamma = float(gamma)
+        self.num_envs = int(num_envs)
+        self._obs = np.zeros((num_envs, n, obs_dim), np.float32)
+        self._act = np.zeros((num_envs, n, act_dim), np.float32)
+        self._rew = np.zeros((num_envs, n), np.float32)
+        self._count = np.zeros(num_envs, np.int64)
+        self._pow = self.gamma ** np.arange(n, dtype=np.float32)
+
+    def _fold_tail(self, e: int, next_obs_e: np.ndarray, done: float, out: list):
+        """Emit all pending entries of env e against next_obs_e."""
+        c = int(self._count[e])
+        for j in range(c):
+            m = c - j
+            reward = float(np.dot(self._rew[e, j:c], self._pow[:m]))
+            disc = 0.0 if done else self.gamma**m
+            out.append(
+                (
+                    self._obs[e, j].copy(),
+                    self._act[e, j].copy(),
+                    reward,
+                    next_obs_e.copy(),
+                    done,
+                    disc,
+                )
+            )
+        self._count[e] = 0
+
+    def step(
+        self,
+        obs: np.ndarray,
+        action: np.ndarray,
+        reward: np.ndarray,
+        next_obs: np.ndarray,
+        done: np.ndarray,
+        truncated: np.ndarray | None = None,
+    ) -> TransitionBatch:
+        """Feed one vector-env step ([E, ...] arrays); returns the folded
+        transitions ready for the buffer (possibly 0 rows)."""
+        e_ids = np.arange(self.num_envs)
+        done = np.asarray(done, bool)
+        truncated = (
+            np.zeros(self.num_envs, bool) if truncated is None else np.asarray(truncated, bool)
+        )
+        # insert current transition into each env's window
+        c = self._count
+        self._obs[e_ids, c] = obs
+        self._act[e_ids, c] = action
+        self._rew[e_ids, c] = reward
+        self._count += 1
+
+        rows: list[tuple] = []
+        # ordinary full-window emission for live envs
+        live_full = (~done) & (~truncated) & (self._count == self.n)
+        for e in np.nonzero(live_full)[0]:
+            reward_n = float(np.dot(self._rew[e], self._pow))
+            rows.append(
+                (
+                    self._obs[e, 0].copy(),
+                    self._act[e, 0].copy(),
+                    reward_n,
+                    next_obs[e].copy(),
+                    0.0,
+                    self.gamma**self.n,
+                )
+            )
+            # slide the window left by one
+            self._obs[e, :-1] = self._obs[e, 1:]
+            self._act[e, :-1] = self._act[e, 1:]
+            self._rew[e, :-1] = self._rew[e, 1:]
+            self._count[e] = self.n - 1
+        # episode boundaries flush everything pending
+        for e in np.nonzero(done)[0]:
+            self._fold_tail(e, next_obs[e], done=1.0, out=rows)
+        for e in np.nonzero(truncated & ~done)[0]:
+            self._fold_tail(e, next_obs[e], done=0.0, out=rows)
+
+        if not rows:
+            z = np.zeros((0,), np.float32)
+            return TransitionBatch(
+                obs=np.zeros((0, self._obs.shape[-1]), np.float32),
+                action=np.zeros((0, self._act.shape[-1]), np.float32),
+                reward=z,
+                next_obs=np.zeros((0, self._obs.shape[-1]), np.float32),
+                done=z,
+                discount=z,
+            )
+        obs_a, act_a, rew_a, nxt_a, dn_a, dc_a = zip(*rows)
+        return TransitionBatch(
+            obs=np.stack(obs_a),
+            action=np.stack(act_a),
+            reward=np.asarray(rew_a, np.float32),
+            next_obs=np.stack(nxt_a),
+            done=np.asarray(dn_a, np.float32),
+            discount=np.asarray(dc_a, np.float32),
+        )

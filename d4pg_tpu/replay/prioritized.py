@@ -1,0 +1,87 @@
+"""Prioritized experience replay with vectorized proportional sampling.
+
+Parity: the reference's ``PrioritizedReplayBuffer``
+(``prioritized_replay_memory.py:224-335``):
+
+  - new transitions enter with priority ``max_priority ** alpha`` (``:251-256``),
+  - proportional sampling by inverse-CDF over the sum tree (``:258-265``),
+  - importance-sampling weights ``(p_i * N) ** -beta`` normalized by the max
+    weight, computed from the min tree (``:299-313``),
+  - ``update_priorities`` writes ``priority ** alpha`` into both trees and
+    tracks the running max (``:315-335``).
+
+Differences: all operations are batched numpy (or the C++ native sampler);
+sampling segments the total mass into B strata (one uniform draw per
+stratum), which is the standard variance-reduction refinement of the
+reference's B independent uniform draws (``:263-264``) — set
+``stratified=False`` for the reference's exact scheme.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from d4pg_tpu.replay.segment_tree import MinTree, SumTree
+from d4pg_tpu.replay.uniform import ReplayBuffer, TransitionBatch
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    def __init__(
+        self,
+        capacity: int,
+        obs_dim: int,
+        act_dim: int,
+        alpha: float = 0.6,
+        seed: int = 0,
+        stratified: bool = True,
+    ):
+        super().__init__(capacity, obs_dim, act_dim, seed=seed)
+        assert alpha >= 0
+        self.alpha = float(alpha)
+        self.stratified = bool(stratified)
+        self._sum = SumTree(self.capacity)
+        self._min = MinTree(self.capacity)
+        self.max_priority = 1.0
+
+    def add(self, batch: TransitionBatch) -> np.ndarray:
+        idx = super().add(batch)
+        p = self.max_priority**self.alpha
+        self._sum.set(idx, np.full(len(idx), p))
+        self._min.set(idx, np.full(len(idx), p))
+        return idx
+
+    def sample_idx(self, batch_size: int) -> np.ndarray:
+        total = self._sum.sum()
+        if self.stratified:
+            bounds = np.linspace(0.0, total, batch_size + 1)
+            mass = self._rng.uniform(bounds[:-1], bounds[1:])
+        else:
+            mass = self._rng.uniform(0.0, total, size=batch_size)
+        idx = self._sum.find_prefixsum(mass)
+        # guard: prefix just at/over the total can land on an unwritten leaf
+        return np.minimum(idx, max(self.size - 1, 0))
+
+    def is_weights(self, idx: np.ndarray, beta: float) -> np.ndarray:
+        """(p_i * N)^-beta / max_weight, max via the min tree
+        (``prioritized_replay_memory.py:299-311``)."""
+        assert beta > 0
+        total = self._sum.sum()
+        p_min = self._min.min() / total
+        max_weight = (p_min * self.size) ** (-beta)
+        p = self._sum.get(idx) / total
+        return ((p * self.size) ** (-beta) / max_weight).astype(np.float32)
+
+    def sample(
+        self, batch_size: int, beta: float = 0.4
+    ) -> tuple[TransitionBatch, np.ndarray, np.ndarray]:
+        """Returns (batch, is_weights, idx); idx feeds update_priorities."""
+        idx = self.sample_idx(batch_size)
+        return self.gather(idx), self.is_weights(idx, beta), idx
+
+    def update_priorities(self, idx: np.ndarray, priorities: np.ndarray) -> None:
+        priorities = np.asarray(priorities, np.float64)
+        assert (priorities > 0).all(), "priorities must be positive"
+        p = priorities**self.alpha
+        self._sum.set(idx, p)
+        self._min.set(idx, p)
+        self.max_priority = max(self.max_priority, float(priorities.max()))

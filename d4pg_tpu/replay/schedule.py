@@ -1,0 +1,26 @@
+"""Annealing schedules.
+
+Parity: the reference's ``LinearSchedule`` (``prioritized_replay_memory.py:
+5-29``), used for PER beta annealing 0.4 -> 1.0 over 100k steps
+(``ddpg.py:82-86``). The reference's schedule is *stateful* — ``value()``
+increments an internal counter on every call (``:25-29``), which couples the
+annealing rate to how often anyone asks. Here the schedule is a pure function
+of an explicit step ``t`` (the learner's step counter), which is also what
+lets it live inside checkpointed train state and stay exact across resume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class LinearSchedule:
+    schedule_timesteps: int
+    final_p: float
+    initial_p: float = 1.0
+
+    def value(self, t: int | float):
+        """Linear interpolation initial_p -> final_p, clamped after T."""
+        frac = min(float(t) / float(self.schedule_timesteps), 1.0)
+        return self.initial_p + frac * (self.final_p - self.initial_p)

@@ -1,0 +1,92 @@
+"""Array-backed segment trees with fully vectorized batched operations.
+
+Parity: the reference's ``SegmentTree`` / ``SumSegmentTree`` /
+``MinSegmentTree`` (``prioritized_replay_memory.py:33-162``, OpenAI-baselines
+lineage). The reference walks the tree one element at a time in Python
+(``find_prefixsum_idx`` at ``:143-148`` is a per-sample pointer chase —
+SURVEY.md flags it as the throughput hazard for a TPU learner). Here:
+
+  - the tree is one flat numpy array of size ``2 * capacity`` (node 1 is the
+    root; leaf i lives at ``capacity + i``),
+  - ``set`` updates B leaves at once, then repairs ancestors level-by-level
+    on the *unique* touched parents — O(B log N) numpy kernel calls total,
+  - ``find_prefixsum`` descends all B queries in lock-step: log2(N) vector
+    steps, each a single compare/where over the batch.
+
+An optional C++ native backend (``d4pg_tpu/replay/_native``) implements the
+same interface for very large batch/capacity; see ``native.py``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class _Tree:
+    """Shared machinery; subclasses define the reduction."""
+
+    _neutral: float
+    _op = None  # np ufunc
+
+    def __init__(self, capacity: int):
+        self.capacity = _next_pow2(int(capacity))
+        self._levels = int(np.log2(self.capacity))
+        self.tree = np.full(2 * self.capacity, self._neutral, np.float64)
+
+    def set(self, idx: np.ndarray, values: np.ndarray) -> None:
+        """Batched leaf assignment + ancestor repair."""
+        idx = np.asarray(idx, np.int64)
+        node = idx + self.capacity
+        self.tree[node] = values
+        parent = np.unique(node >> 1)
+        while parent[0] >= 1:
+            left = parent << 1
+            self.tree[parent] = self._op(self.tree[left], self.tree[left | 1])
+            parent = np.unique(parent >> 1)
+            if parent[0] == 0:
+                break
+
+    def get(self, idx: np.ndarray) -> np.ndarray:
+        return self.tree[np.asarray(idx, np.int64) + self.capacity]
+
+    @property
+    def root(self) -> float:
+        return float(self.tree[1])
+
+
+class SumTree(_Tree):
+    _neutral = 0.0
+    _op = staticmethod(np.add)
+
+    def sum(self) -> float:
+        return self.root
+
+    def find_prefixsum(self, prefix: np.ndarray) -> np.ndarray:
+        """Batched inverse-CDF: for each p, the smallest leaf i such that
+        ``sum(leaves[:i+1]) > p``. Vectorized lock-step descent — the
+        reference's ``find_prefixsum_idx`` (``prioritized_replay_memory.py:
+        126-149``) for a whole batch in log2(N) numpy steps."""
+        p = np.asarray(prefix, np.float64).copy()
+        node = np.ones_like(p, dtype=np.int64)  # root
+        for _ in range(self._levels):
+            left = node << 1
+            left_sum = self.tree[left]
+            go_right = p >= left_sum
+            p = np.where(go_right, p - left_sum, p)
+            node = np.where(go_right, left | 1, left)
+        return node - self.capacity
+
+
+class MinTree(_Tree):
+    _neutral = np.inf
+    _op = staticmethod(np.minimum)
+
+    def min(self) -> float:
+        return self.root

@@ -1,0 +1,44 @@
+"""Async host->device batch staging.
+
+SURVEY.md §7 "hard parts": replay sampling + H2D transfer must hide under
+the XLA learner step. ``DeviceStager`` keeps one batch in flight: while the
+TPU executes step t on batch t, the host samples and ``device_put``s batch
+t+1 (JAX dispatch is async, so ``device_put`` returns immediately and the
+transfer overlaps with compute).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator
+
+import jax
+
+
+class DeviceStager:
+    """Double-buffered prefetch of host batches onto a device (or sharding)."""
+
+    def __init__(
+        self,
+        sample_fn: Callable[[], object],
+        device=None,
+    ):
+        self._sample = sample_fn
+        self._device = device
+        self._inflight = None
+
+    def _put(self):
+        batch = self._sample()
+        if self._device is not None:
+            return jax.device_put(batch, self._device)
+        return jax.device_put(batch)
+
+    def next(self):
+        """Return the prefetched batch and immediately start staging the
+        following one."""
+        out = self._inflight if self._inflight is not None else self._put()
+        self._inflight = self._put()
+        return out
+
+    def __iter__(self) -> Iterator:
+        while True:
+            yield self.next()

@@ -1,0 +1,87 @@
+"""Uniform replay: a preallocated numpy ring buffer of transitions.
+
+Parity: the reference's ``Replay`` (``replay_memory.py:14-80``) and
+``ReplayBuffer`` base (``prioritized_replay_memory.py:164-222``) — a ring of
+``(s, a, r, s', done)`` tuples with uniform sampling. TPU-first differences:
+
+  - storage is preallocated contiguous float32 arrays (the reference appends
+    python tuples and re-stacks to float64 on every sample,
+    ``replay_memory.py:61-80``), so sampling is a single fancy-index gather
+    ready for zero-copy ``device_put``;
+  - each transition carries an explicit ``discount`` = gamma^m * (1 - done)
+    folded at insert time by the n-step machinery (resurrecting the
+    reference's dead n-step code path, ``replay_memory.py:21-58`` /
+    ``main.py:209-242``, properly);
+  - batched vectorized ``add``; no per-step Python loop;
+  - sampling is with replacement by default (like the PER base ring,
+    ``prioritized_replay_memory.py:221``); ``replace=False`` gives the
+    uniform ``Replay.sample`` behavior (``replay_memory.py:61``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+
+class TransitionBatch(NamedTuple):
+    """A batch of (possibly n-step-folded) transitions, host numpy arrays."""
+
+    obs: np.ndarray  # [B, obs_dim] float32
+    action: np.ndarray  # [B, act_dim] float32
+    reward: np.ndarray  # [B] float32 (n-step folded return)
+    next_obs: np.ndarray  # [B, obs_dim] float32 (s_{t+n})
+    done: np.ndarray  # [B] float32
+    discount: np.ndarray  # [B] float32 = gamma^m * (1 - done)
+
+
+class ReplayBuffer:
+    """Fixed-capacity ring buffer over preallocated numpy storage."""
+
+    def __init__(self, capacity: int, obs_dim: int, act_dim: int, seed: int = 0):
+        self.capacity = int(capacity)
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.action = np.zeros((capacity, act_dim), np.float32)
+        self.reward = np.zeros((capacity,), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.done = np.zeros((capacity,), np.float32)
+        self.discount = np.zeros((capacity,), np.float32)
+        self.size = 0
+        self.head = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self.size
+
+    def add(self, batch: TransitionBatch) -> np.ndarray:
+        """Insert a batch of transitions; returns the slot indices written."""
+        n = batch.obs.shape[0]
+        if n > self.capacity:
+            raise ValueError(f"batch of {n} exceeds capacity {self.capacity}")
+        idx = (self.head + np.arange(n)) % self.capacity
+        self.obs[idx] = batch.obs
+        self.action[idx] = batch.action
+        self.reward[idx] = batch.reward
+        self.next_obs[idx] = batch.next_obs
+        self.done[idx] = batch.done
+        self.discount[idx] = batch.discount
+        self.head = int((self.head + n) % self.capacity)
+        self.size = int(min(self.size + n, self.capacity))
+        return idx
+
+    def gather(self, idx: np.ndarray) -> TransitionBatch:
+        return TransitionBatch(
+            obs=self.obs[idx],
+            action=self.action[idx],
+            reward=self.reward[idx],
+            next_obs=self.next_obs[idx],
+            done=self.done[idx],
+            discount=self.discount[idx],
+        )
+
+    def sample(self, batch_size: int, replace: bool = True) -> TransitionBatch:
+        if self.size == 0:
+            raise ValueError("cannot sample from an empty buffer")
+        idx = self._rng.choice(self.size, size=batch_size, replace=replace)
+        return self.gather(idx)

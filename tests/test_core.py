@@ -1,0 +1,134 @@
+"""Losses, target updates, noise, MoG math."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from d4pg_tpu.core import (
+    CategoricalSupport,
+    categorical_td_loss,
+    expected_q,
+    gaussian,
+    hard_update,
+    ou,
+    policy_loss,
+    soft_update,
+)
+from d4pg_tpu.core.losses import cross_entropy_per_sample, reference_td_error
+from d4pg_tpu.core.mog import mog_log_prob, mog_mean, mog_target, mog_td_loss
+from d4pg_tpu.models.critic import MoGParams
+
+
+def test_categorical_td_loss_matches_reference_formula(rng):
+    proj = rng.random((8, 51)).astype(np.float32)
+    proj /= proj.sum(-1, keepdims=True)
+    q = rng.random((8, 51)).astype(np.float32)
+    q /= q.sum(-1, keepdims=True)
+    loss, td = categorical_td_loss(jnp.asarray(proj), jnp.asarray(q))
+    want = -(proj * np.log(q + 1e-10)).sum(-1)  # ddpg.py:217
+    np.testing.assert_allclose(np.asarray(td), want, rtol=1e-5)
+    assert loss == pytest.approx(want.mean(), rel=1e-5)
+    # IS weights reweight the mean
+    w = rng.random(8).astype(np.float32)
+    loss_w, _ = categorical_td_loss(jnp.asarray(proj), jnp.asarray(q), jnp.asarray(w))
+    assert loss_w == pytest.approx((w * want).mean(), rel=1e-5)
+
+
+def test_reference_td_error_formula(rng):
+    proj = rng.random((4, 11)).astype(np.float32)
+    q = rng.random((4, 11)).astype(np.float32)
+    got = np.asarray(reference_td_error(jnp.asarray(proj), jnp.asarray(q)))
+    np.testing.assert_allclose(got, -(proj * q).sum(-1), rtol=1e-5)
+
+
+def test_policy_loss_is_negative_expected_q():
+    support = CategoricalSupport(-1.0, 1.0, 3)
+    probs = jnp.array([[0.0, 0.0, 1.0], [1.0, 0.0, 0.0]])
+    assert np.asarray(expected_q(support, probs)) == pytest.approx([1.0, -1.0])
+    assert policy_loss(support, probs) == pytest.approx(0.0)
+
+
+def test_soft_update_lerp():
+    t = {"w": jnp.ones(3)}
+    o = {"w": jnp.zeros(3)}
+    out = soft_update(t, o, tau=0.1)
+    np.testing.assert_allclose(np.asarray(out["w"]), 0.9)
+    h = hard_update(t, o)
+    np.testing.assert_allclose(np.asarray(h["w"]), 0.0)
+
+
+def test_gaussian_noise_decay_and_scale():
+    st = gaussian.init(horizon=100)
+    assert float(st.epsilon) == pytest.approx(0.3)
+    key = jax.random.PRNGKey(0)
+    s = gaussian.sample(st, key, (4,))
+    # epsilon-scaled: same key with eps=1 gives s / 0.3
+    st1 = st._replace(epsilon=jnp.asarray(1.0))
+    np.testing.assert_allclose(
+        np.asarray(gaussian.sample(st1, key, (4,))) * 0.3, np.asarray(s), rtol=1e-6
+    )
+    # reset decays epsilon monotonically toward min
+    eps = [float(st.epsilon)]
+    for _ in range(200):
+        st = gaussian.reset(st, horizon=100)
+        eps.append(float(st.epsilon))
+    assert eps[-1] == pytest.approx(0.01, abs=1e-3)
+    assert all(b <= a or a == pytest.approx(0.3) for a, b in zip(eps[1:], eps[2:]))
+
+
+def test_ou_noise_mean_reversion_and_reset():
+    st = ou.init(act_dim=2)
+    key = jax.random.PRNGKey(1)
+    xs = []
+    for i in range(500):
+        st, x = ou.sample(st, jax.random.fold_in(key, i), theta=0.5, sigma=0.05)
+        xs.append(np.asarray(x))
+    xs = np.stack(xs)
+    assert np.abs(xs.mean(0)).max() < 0.5  # mean-reverts around 0
+    st = ou.reset(st, horizon=100)
+    np.testing.assert_allclose(np.asarray(st.x), 0.0)
+    assert float(st.epsilon) < 1.0
+
+
+def test_mog_target_and_loss_decreases_toward_truth():
+    params = MoGParams(
+        log_weights=jnp.log(jnp.array([[0.5, 0.5]])),
+        means=jnp.array([[0.0, 2.0]]),
+        stds=jnp.array([[1.0, 1.0]]),
+    )
+    # Bellman map
+    tgt = mog_target(params, rewards=jnp.array([1.0]), discounts=jnp.array([0.5]))
+    np.testing.assert_allclose(np.asarray(tgt.means), [[1.0, 2.0]])
+    np.testing.assert_allclose(np.asarray(tgt.stds), [[0.5, 0.5]])
+    assert mog_mean(params) == pytest.approx(1.0)
+    # terminal collapse: discount 0 -> point-ish mass at r (std floored)
+    term = mog_target(params, jnp.array([3.0]), jnp.array([0.0]))
+    np.testing.assert_allclose(np.asarray(term.means), [[3.0, 3.0]])
+    # CE(target, pred) is lower when pred == target than when far away
+    key = jax.random.PRNGKey(0)
+    loss_match, td = mog_td_loss(tgt, tgt, key, n_samples=256)
+    far = MoGParams(tgt.log_weights, tgt.means + 10.0, tgt.stds)
+    loss_far, _ = mog_td_loss(far, tgt, key, n_samples=256)
+    assert float(loss_match) < float(loss_far)
+    assert td.shape == (1,)
+
+
+def test_mog_log_prob_matches_scipy_single_gaussian():
+    from scipy.stats import norm
+
+    params = MoGParams(
+        log_weights=jnp.zeros((1, 1)), means=jnp.array([[1.5]]), stds=jnp.array([[2.0]])
+    )
+    x = jnp.array([[0.0, 1.5, 4.0]])
+    got = np.asarray(mog_log_prob(params, x))[0]
+    want = norm.logpdf([0.0, 1.5, 4.0], loc=1.5, scale=2.0)
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_cross_entropy_nonnegative_vs_entropy(rng):
+    p = rng.random((16, 21)).astype(np.float32)
+    p /= p.sum(-1, keepdims=True)
+    ce = np.asarray(cross_entropy_per_sample(jnp.asarray(p), jnp.asarray(p)))
+    ent = -(p * np.log(p + 1e-10)).sum(-1)
+    np.testing.assert_allclose(ce, ent, rtol=1e-5)

@@ -1,0 +1,101 @@
+"""Flax model shape/init/semantics tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from d4pg_tpu.models import (
+    Actor,
+    CategoricalCritic,
+    MixtureOfGaussianCritic,
+    PixelActor,
+    PixelCategoricalCritic,
+)
+
+
+@pytest.fixture
+def key():
+    return jax.random.PRNGKey(0)
+
+
+def test_actor_shapes_and_bounds(key):
+    m = Actor(act_dim=6)
+    obs = jax.random.normal(key, (32, 17))
+    params = m.init(key, obs)
+    a = m.apply(params, obs)
+    assert a.shape == (32, 6)
+    assert (np.abs(np.asarray(a)) <= 1.0).all()  # tanh-bounded
+
+
+def test_actor_hidden_structure(key):
+    """Three ReLU'd hidden layers of width 256 + output head (SURVEY §7:
+    the reference's missing-activation quirk is intentionally not kept)."""
+    m = Actor(act_dim=2)
+    params = m.init(key, jnp.zeros((1, 3)))["params"]
+    assert set(params) == {"fc1", "fc2", "fc3", "out"}
+    assert params["fc1"]["kernel"].shape == (3, 256)
+    assert params["out"]["kernel"].shape == (256, 2)
+    # fan-in init: std ~ 1/sqrt(fan_in)
+    k = np.asarray(params["fc2"]["kernel"])
+    assert k.std() == pytest.approx(1.0 / np.sqrt(256), rel=0.15)
+    assert np.asarray(params["out"]["kernel"]).std() == pytest.approx(3e-3, rel=0.2)
+
+
+def test_categorical_critic_probs_and_logits(key):
+    m = CategoricalCritic(n_atoms=51)
+    obs = jax.random.normal(key, (8, 11))
+    act = jax.random.normal(key, (8, 3))
+    params = m.init(key, obs, act)
+    p = m.apply(params, obs, act)
+    assert p.shape == (8, 51)
+    np.testing.assert_allclose(np.asarray(p).sum(-1), 1.0, rtol=1e-5)
+    logits = m.apply(params, obs, act, return_logits=True)
+    np.testing.assert_allclose(
+        np.asarray(jax.nn.softmax(logits, -1)), np.asarray(p), rtol=1e-5
+    )
+
+
+def test_critic_action_enters_second_layer(key):
+    """Action concatenated after the first layer (``models.py:80``): the
+    fc2 kernel's input width is hidden + act_dim."""
+    m = CategoricalCritic(n_atoms=11)
+    params = m.init(key, jnp.zeros((1, 5)), jnp.zeros((1, 4)))["params"]
+    torso = params["torso"]
+    assert torso["fc1"]["kernel"].shape == (5, 256)
+    assert torso["fc2"]["kernel"].shape == (256 + 4, 256)
+
+
+def test_mog_critic_outputs_valid_mixture(key):
+    m = MixtureOfGaussianCritic(n_components=5)
+    obs = jax.random.normal(key, (4, 7))
+    act = jax.random.normal(key, (4, 2))
+    params = m.init(key, obs, act)
+    out = m.apply(params, obs, act)
+    assert out.means.shape == (4, 5)
+    np.testing.assert_allclose(
+        np.exp(np.asarray(out.log_weights)).sum(-1), 1.0, rtol=1e-4
+    )
+    assert (np.asarray(out.stds) > 0).all()
+
+
+def test_pixel_models(key):
+    px = jax.random.randint(key, (2, 84, 84, 3), 0, 255, dtype=jnp.uint8)
+    actor = PixelActor(act_dim=6)
+    p = actor.init(key, px)
+    a = actor.apply(p, px)
+    assert a.shape == (2, 6)
+    critic = PixelCategoricalCritic(n_atoms=51)
+    pc = critic.init(key, px, a)
+    z = critic.apply(pc, px, a)
+    assert z.shape == (2, 51)
+    np.testing.assert_allclose(np.asarray(z).sum(-1), 1.0, rtol=1e-4)
+
+
+def test_actor_jits_with_static_shapes(key):
+    m = Actor(act_dim=3)
+    obs = jnp.zeros((16, 9))
+    params = m.init(key, obs)
+    f = jax.jit(lambda p, o: m.apply(p, o))
+    out = f(params, obs)
+    assert out.shape == (16, 3)

@@ -1,0 +1,255 @@
+"""Replay storage, segment trees, PER, n-step folding, schedules."""
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.replay import (
+    LinearSchedule,
+    MinTree,
+    NStepFolder,
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+    SumTree,
+    TransitionBatch,
+)
+
+
+def make_batch(n, obs_dim=3, act_dim=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return TransitionBatch(
+        obs=rng.normal(size=(n, obs_dim)).astype(np.float32),
+        action=rng.normal(size=(n, act_dim)).astype(np.float32),
+        reward=rng.normal(size=n).astype(np.float32),
+        next_obs=rng.normal(size=(n, obs_dim)).astype(np.float32),
+        done=(rng.random(n) < 0.2).astype(np.float32),
+        discount=rng.random(n).astype(np.float32),
+    )
+
+
+# ---------------- schedules ----------------
+
+
+def test_linear_schedule_matches_reference_semantics():
+    # beta 0.4 -> 1.0 over 100k (ddpg.py:82-86), pure function of t
+    s = LinearSchedule(100_000, final_p=1.0, initial_p=0.4)
+    assert s.value(0) == pytest.approx(0.4)
+    assert s.value(50_000) == pytest.approx(0.7)
+    assert s.value(100_000) == pytest.approx(1.0)
+    assert s.value(1_000_000) == pytest.approx(1.0)  # clamped
+
+
+# ---------------- uniform ring ----------------
+
+
+def test_ring_wraparound_and_sampling():
+    buf = ReplayBuffer(capacity=8, obs_dim=3, act_dim=2)
+    b1 = make_batch(6, seed=1)
+    idx = buf.add(b1)
+    assert list(idx) == list(range(6))
+    assert len(buf) == 6
+    b2 = make_batch(5, seed=2)
+    idx2 = buf.add(b2)
+    assert list(idx2) == [6, 7, 0, 1, 2]  # wraps
+    assert len(buf) == 8
+    # overwritten slots hold the new data
+    np.testing.assert_array_equal(buf.obs[0], b2.obs[2])
+    s = buf.sample(16)
+    assert s.obs.shape == (16, 3)
+    s2 = buf.sample(8, replace=False)
+    assert len(np.unique(s2.reward)) == 8 or len(buf) < 8
+
+
+def test_empty_sample_raises():
+    buf = ReplayBuffer(4, 1, 1)
+    with pytest.raises(ValueError):
+        buf.sample(2)
+
+
+# ---------------- segment trees ----------------
+
+
+def test_sum_tree_matches_numpy(rng):
+    t = SumTree(100)  # rounds to 128
+    vals = rng.random(100)
+    t.set(np.arange(100), vals)
+    assert t.sum() == pytest.approx(vals.sum())
+    # partial update
+    upd_idx = rng.integers(0, 100, 17)
+    upd_val = rng.random(17)
+    t.set(upd_idx, upd_val)
+    vals2 = vals.copy()
+    vals2[upd_idx] = upd_val  # note: duplicate idx -> last write wins, same as tree
+    # rebuild expected with duplicates resolved in order
+    for i, v in zip(upd_idx, upd_val):
+        vals[i] = v
+    assert t.sum() == pytest.approx(vals.sum())
+    np.testing.assert_allclose(t.get(np.arange(100)), vals)
+
+
+def test_find_prefixsum_inverse_cdf(rng):
+    vals = rng.random(64)
+    t = SumTree(64)
+    t.set(np.arange(64), vals)
+    cdf = np.cumsum(vals)
+    queries = rng.uniform(0, cdf[-1] - 1e-9, 1000)
+    got = t.find_prefixsum(queries)
+    want = np.searchsorted(cdf, queries, side="right")
+    np.testing.assert_array_equal(got, want)
+
+
+def test_find_prefixsum_with_zeros():
+    t = SumTree(8)
+    t.set(np.arange(8), np.array([0.0, 2.0, 0.0, 0.0, 1.0, 0.0, 0.0, 0.0]))
+    got = t.find_prefixsum(np.array([0.0, 1.9, 2.0, 2.5]))
+    np.testing.assert_array_equal(got, [1, 1, 4, 4])
+
+
+def test_min_tree(rng):
+    vals = rng.random(33) + 0.1
+    t = MinTree(33)
+    t.set(np.arange(33), vals)
+    assert t.min() == pytest.approx(vals.min())
+    t.set(np.array([7]), np.array([0.01]))
+    assert t.min() == pytest.approx(0.01)
+
+
+# ---------------- PER ----------------
+
+
+def test_per_proportional_sampling_statistics():
+    buf = PrioritizedReplayBuffer(64, 1, 1, alpha=1.0, seed=3)
+    n = 32
+    batch = make_batch(n, 1, 1)
+    idx = buf.add(batch)
+    # give item 5 priority 9x the others -> expect ~9x sample frequency
+    pri = np.ones(n)
+    pri[5] = 9.0
+    buf.update_priorities(idx, pri)
+    counts = np.zeros(n)
+    for _ in range(300):
+        i = buf.sample_idx(64)
+        counts += np.bincount(i, minlength=n)
+    freq = counts / counts.sum()
+    expected_5 = 9.0 / (n - 1 + 9.0)
+    assert freq[5] == pytest.approx(expected_5, rel=0.15)
+
+
+def test_per_is_weights_match_formula():
+    buf = PrioritizedReplayBuffer(16, 1, 1, alpha=0.6)
+    idx = buf.add(make_batch(8, 1, 1))
+    pri = np.arange(1.0, 9.0)
+    buf.update_priorities(idx, pri)
+    beta = 0.5
+    w = buf.is_weights(idx, beta)
+    p = pri**0.6
+    probs = p / p.sum()
+    want = (probs * 8) ** (-beta)
+    want = want / ((probs.min() * 8) ** (-beta))
+    np.testing.assert_allclose(w, want.astype(np.float32), rtol=1e-5)
+    assert w.max() == pytest.approx(1.0)
+
+
+def test_per_new_items_get_max_priority():
+    buf = PrioritizedReplayBuffer(16, 1, 1, alpha=1.0)
+    i1 = buf.add(make_batch(2, 1, 1))
+    buf.update_priorities(i1, np.array([10.0, 1.0]))
+    i2 = buf.add(make_batch(1, 1, 1, seed=9))
+    # new item inherits max_priority (=10)
+    assert buf._sum.get(i2)[0] == pytest.approx(10.0)
+
+
+def test_per_sample_roundtrip():
+    buf = PrioritizedReplayBuffer(32, 2, 1)
+    buf.add(make_batch(20, 2, 1))
+    batch, w, idx = buf.sample(10, beta=0.4)
+    assert batch.obs.shape == (10, 2)
+    assert w.shape == (10,) and idx.shape == (10,)
+    assert (idx < 20).all()
+    buf.update_priorities(idx, np.abs(np.random.default_rng(0).normal(size=10)) + 1e-6)
+
+
+# ---------------- n-step ----------------
+
+
+def test_nstep_one_step_passthrough():
+    f = NStepFolder(n=1, gamma=0.9, num_envs=1, obs_dim=1, act_dim=1)
+    out = f.step(
+        obs=np.array([[1.0]]),
+        action=np.array([[0.5]]),
+        reward=np.array([2.0]),
+        next_obs=np.array([[1.5]]),
+        done=np.array([False]),
+    )
+    assert out.reward[0] == pytest.approx(2.0)
+    assert out.discount[0] == pytest.approx(0.9)
+    assert out.done[0] == 0.0
+
+
+def test_nstep_fold_and_terminal_flush():
+    gamma = 0.5
+    f = NStepFolder(n=3, gamma=gamma, num_envs=1, obs_dim=1, act_dim=1)
+
+    def step(t, r, done=False):
+        return f.step(
+            obs=np.array([[float(t)]]),
+            action=np.array([[0.0]]),
+            reward=np.array([r]),
+            next_obs=np.array([[float(t + 1)]]),
+            done=np.array([done]),
+        )
+
+    assert step(0, 1.0).reward.size == 0  # window filling
+    assert step(1, 2.0).reward.size == 0
+    out = step(2, 4.0)  # full window: fold r0 + g r1 + g^2 r2
+    assert out.reward[0] == pytest.approx(1.0 + 0.5 * 2.0 + 0.25 * 4.0)
+    assert out.obs[0, 0] == 0.0 and out.next_obs[0, 0] == 3.0
+    assert out.discount[0] == pytest.approx(gamma**3)
+    # terminal: flush remaining tail (entries t=1,2 pending + new t=3)
+    out = step(3, 8.0, done=True)
+    assert out.reward.shape == (3,)
+    np.testing.assert_allclose(
+        out.reward, [2.0 + 0.5 * 4 + 0.25 * 8, 4 + 0.5 * 8, 8.0]
+    )
+    assert (out.done == 1.0).all() and (out.discount == 0.0).all()
+    # all flushed transitions bootstrap against the terminal next_obs
+    assert (out.next_obs == 4.0).all()
+    # window resets after terminal
+    assert step(0, 1.0).reward.size == 0
+
+
+def test_nstep_truncation_bootstraps():
+    gamma = 0.9
+    f = NStepFolder(n=2, gamma=gamma, num_envs=1, obs_dim=1, act_dim=1)
+    f.step(
+        obs=np.array([[0.0]]), action=np.array([[0.0]]), reward=np.array([1.0]),
+        next_obs=np.array([[1.0]]), done=np.array([False]),
+    )
+    out = f.step(
+        obs=np.array([[1.0]]), action=np.array([[0.0]]), reward=np.array([3.0]),
+        next_obs=np.array([[2.0]]), done=np.array([False]),
+        truncated=np.array([True]),
+    )
+    # full-window emission AND truncation flush of the remaining tail
+    assert out.reward.shape == (2,)
+    assert out.reward[0] == pytest.approx(1.0 + gamma * 3.0)
+    assert out.discount[0] == pytest.approx(gamma**2)
+    assert out.done[0] == 0.0  # truncation is not termination
+    assert out.reward[1] == pytest.approx(3.0)
+    assert out.discount[1] == pytest.approx(gamma)
+
+
+def test_nstep_multi_env_independent():
+    f = NStepFolder(n=2, gamma=1.0, num_envs=2, obs_dim=1, act_dim=1)
+    f.step(
+        obs=np.zeros((2, 1)), action=np.zeros((2, 1)),
+        reward=np.array([1.0, 10.0]), next_obs=np.ones((2, 1)),
+        done=np.array([False, False]),
+    )
+    out = f.step(
+        obs=np.ones((2, 1)), action=np.zeros((2, 1)),
+        reward=np.array([2.0, 20.0]), next_obs=np.full((2, 1), 2.0),
+        done=np.array([False, True]),
+    )
+    # env0: folded 2-step (1+2); env1: terminal flush of both entries
+    rewards = sorted(out.reward.tolist())
+    assert rewards == pytest.approx([3.0, 20.0, 30.0])
