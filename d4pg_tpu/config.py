@@ -1,0 +1,181 @@
+"""Typed experiment configuration + CLI front-end.
+
+Parity: the reference's argparse surface (``main.py:31-56``) and its
+config-mutating hooks (``main.py:84-99, 379-380``), as a frozen dataclass
+with per-env presets (SURVEY.md §5 config-system mandate). Every reference
+flag maps to a field; flags the reference exposes but never wires live
+(``--ou_theta/--ou_sigma/--ou_mu``, SURVEY.md C6) are wired for real via
+``noise='ou'``. Run-dir naming encodes the config like the reference's
+``runs/exp_<env>__PER?_HER?_<n>N_<k>Workers`` (``main.py:59-66``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+
+from d4pg_tpu.envs.presets import get_preset
+from d4pg_tpu.learner.state import D4PGConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    # env
+    env: str = "Pendulum-v1"  # --env
+    max_steps: int = 200  # --max_steps (episode horizon)
+    num_envs: int = 4  # vectorized pool width (reference: 1)
+    her: bool = False  # --her
+    her_ratio: float = 0.8  # main.py:165
+    reward_scale: float = 1.0
+    # replay
+    memory_size: int = 1_000_000  # --rmsize
+    batch_size: int = 64  # --bsize
+    warmup: int = 5000  # --warmup (main.py:200-207)
+    prioritized_replay: bool = True  # --p_replay
+    per_alpha: float = 0.6  # ddpg.py:81
+    per_beta0: float = 0.4  # ddpg.py:84
+    per_beta_steps: int = 100_000  # ddpg.py:85
+    n_steps: int = 3  # --n_steps
+    # algorithm
+    gamma: float = 0.99  # --gamma
+    tau: float = 0.001  # --tau
+    lr_actor: float = 1e-4
+    lr_critic: float = 1e-3
+    adam_b1: float = 0.9
+    adam_b2: float = 0.999  # reference (0.9, 0.9) available via flags
+    v_min: float | None = None  # --v_min (None: from preset)
+    v_max: float | None = None  # --v_max
+    n_atoms: int = 51  # --n_atoms
+    critic_family: str = "categorical"
+    hidden: tuple = (256, 256, 256)
+    # exploration
+    noise: str = "gaussian"  # 'gaussian' | 'ou'
+    epsilon_0: float = 0.3  # random_process.py:11
+    min_epsilon: float = 0.01
+    epsilon_horizon: int = 5000
+    ou_theta: float = 0.25  # --ou_theta (main.py:36, dead in reference)
+    ou_sigma: float = 0.05  # --ou_sigma
+    ou_mu: float = 0.0  # --ou_mu
+    # loop shape (main.py:299-312)
+    n_epochs: int = 20  # --n_eps
+    n_cycles: int = 50
+    episodes_per_cycle: int = 16
+    train_steps_per_cycle: int = 40
+    eval_trials: int = 10
+    # distributed
+    n_workers: int = 1  # --n_workers (actor count)
+    data_parallel: int = 1  # learner mesh data axis (1 = single device)
+    # io
+    log_dir: str = "runs"  # --log_dir
+    seed: int = 0
+    checkpoint_every: int = 1  # cycles between checkpoints (main.py:367)
+    resume: bool = False
+    debug: bool = False  # --debug
+
+    def run_name(self) -> str:
+        """Config-encoded run dir (parity: ``main.py:59-64``)."""
+        return (
+            f"exp_{self.env}_"
+            f"{'_PER' if self.prioritized_replay else ''}"
+            f"{'_HER' if self.her else ''}"
+            f"_{self.n_steps}N_{self.n_workers}Workers"
+        )
+
+    def resolve(self) -> "ExperimentConfig":
+        """Fill v_min/v_max (+ reward scale / horizon) from the env preset
+        when unset (the ``configure_env_params`` hook, ``main.py:84-99``)."""
+        preset = get_preset(self.env)
+        updates = {}
+        if self.v_min is None:
+            updates["v_min"] = preset.v_min
+        if self.v_max is None:
+            updates["v_max"] = preset.v_max
+        if self.reward_scale == 1.0 and preset.reward_scale != 1.0:
+            updates["reward_scale"] = preset.reward_scale
+        return dataclasses.replace(self, **updates) if updates else self
+
+    def learner_config(self, obs_dim: int, act_dim: int) -> D4PGConfig:
+        resolved = self.resolve()
+        return D4PGConfig(
+            obs_dim=obs_dim,
+            act_dim=act_dim,
+            v_min=float(resolved.v_min),
+            v_max=float(resolved.v_max),
+            n_atoms=self.n_atoms,
+            hidden=tuple(self.hidden),
+            critic_family=self.critic_family,
+            lr_actor=self.lr_actor,
+            lr_critic=self.lr_critic,
+            adam_b1=self.adam_b1,
+            adam_b2=self.adam_b2,
+            tau=self.tau,
+            gamma=self.gamma,
+        )
+
+
+def _add_bool_flag(parser: argparse.ArgumentParser, name: str, default: bool, help_: str):
+    """0/1 int flags like the reference's --p_replay/--her/--multithread
+    (``main.py:44`` quirk: --debug as type=bool parses any string truthy —
+    not reproduced)."""
+    parser.add_argument(f"--{name}", type=int, choices=(0, 1),
+                        default=int(default), help=help_)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    d = ExperimentConfig()
+    p = argparse.ArgumentParser(
+        prog="d4pg_tpu.train",
+        description="TPU-native D4PG (capability parity with ajgupta93/d4pg-pytorch)",
+    )
+    p.add_argument("--env", default=d.env)
+    p.add_argument("--max_steps", type=int, default=d.max_steps)
+    p.add_argument("--num_envs", type=int, default=d.num_envs)
+    _add_bool_flag(p, "her", d.her, "hindsight experience replay")
+    p.add_argument("--her_ratio", type=float, default=d.her_ratio)
+    p.add_argument("--rmsize", type=int, default=d.memory_size, dest="memory_size")
+    p.add_argument("--bsize", type=int, default=d.batch_size, dest="batch_size")
+    p.add_argument("--warmup", type=int, default=d.warmup)
+    _add_bool_flag(p, "p_replay", d.prioritized_replay, "prioritized replay")
+    p.add_argument("--per_alpha", type=float, default=d.per_alpha)
+    p.add_argument("--per_beta0", type=float, default=d.per_beta0)
+    p.add_argument("--per_beta_steps", type=int, default=d.per_beta_steps)
+    p.add_argument("--n_steps", type=int, default=d.n_steps)
+    p.add_argument("--gamma", type=float, default=d.gamma)
+    p.add_argument("--tau", type=float, default=d.tau)
+    p.add_argument("--lr_actor", type=float, default=d.lr_actor)
+    p.add_argument("--lr_critic", type=float, default=d.lr_critic)
+    p.add_argument("--adam_b1", type=float, default=d.adam_b1)
+    p.add_argument("--adam_b2", type=float, default=d.adam_b2)
+    p.add_argument("--v_min", type=float, default=None)
+    p.add_argument("--v_max", type=float, default=None)
+    p.add_argument("--n_atoms", type=int, default=d.n_atoms)
+    p.add_argument("--critic_family", choices=("categorical", "mog"),
+                   default=d.critic_family)
+    p.add_argument("--noise", choices=("gaussian", "ou"), default=d.noise)
+    p.add_argument("--epsilon_0", type=float, default=d.epsilon_0)
+    p.add_argument("--ou_theta", type=float, default=d.ou_theta)
+    p.add_argument("--ou_sigma", type=float, default=d.ou_sigma)
+    p.add_argument("--ou_mu", type=float, default=d.ou_mu)
+    p.add_argument("--n_eps", type=int, default=d.n_epochs, dest="n_epochs")
+    p.add_argument("--n_cycles", type=int, default=d.n_cycles)
+    p.add_argument("--episodes_per_cycle", type=int, default=d.episodes_per_cycle)
+    p.add_argument("--train_steps_per_cycle", type=int,
+                   default=d.train_steps_per_cycle)
+    p.add_argument("--eval_trials", type=int, default=d.eval_trials)
+    p.add_argument("--n_workers", type=int, default=d.n_workers)
+    p.add_argument("--data_parallel", type=int, default=d.data_parallel)
+    p.add_argument("--log_dir", default=d.log_dir)
+    p.add_argument("--seed", type=int, default=d.seed)
+    p.add_argument("--reward_scale", type=float, default=d.reward_scale)
+    _add_bool_flag(p, "resume", d.resume, "resume from latest checkpoint")
+    _add_bool_flag(p, "debug", d.debug, "debug logging")
+    return p
+
+
+def parse_args(argv=None) -> ExperimentConfig:
+    ns = vars(build_parser().parse_args(argv))
+    ns["her"] = bool(ns["her"])
+    ns["prioritized_replay"] = bool(ns.pop("p_replay"))
+    ns["resume"] = bool(ns["resume"])
+    ns["debug"] = bool(ns["debug"])
+    return ExperimentConfig(**ns)

@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import TypeVar
 
 import jax
+import jax.numpy as jnp
 
 T = TypeVar("T")
 
@@ -24,6 +25,10 @@ def soft_update(target: T, online: T, tau: float) -> T:
 
 
 def hard_update(target: T, online: T) -> T:
-    """Copy online params into the target pytree (``ddpg.py:92-94``)."""
+    """Copy online params into the target pytree (``ddpg.py:92-94``).
+
+    Real copies, not identity aliases: aliased target/online buffers break
+    buffer donation in the jit'd update.
+    """
     del target
-    return jax.tree_util.tree_map(lambda o: o, online)
+    return jax.tree_util.tree_map(jnp.copy, online)

@@ -1,0 +1,36 @@
+"""Distributed actor–learner runtime.
+
+The reference's runtime is N fork'd worker processes that are each actor AND
+learner, racing hogwild updates into shared memory (``main.py:371-405``,
+SURVEY.md C15/C18). The TPU-native architecture decouples the roles per the
+D4PG paper shape the reference only gestures at (SURVEY.md §2):
+
+  - a single synchronous **learner** owning the replay buffer and the jit'd
+    (sharded) update;
+  - N **actors** that pull versioned weights and stream folded transitions
+    into the learner's replay service — in-process threads on one host, or
+    socket transport across TPU-VM hosts over DCN;
+  - an **evaluator** that periodically copies weights and reports greedy
+    returns with the reference's 0.95/0.05 EWMA (``main.py:131``);
+  - heartbeats + stateless-restartable actors for failure detection
+    (SURVEY.md §5 — the reference has none).
+"""
+
+from d4pg_tpu.distributed.weights import WeightStore
+from d4pg_tpu.distributed.replay_service import ReplayService
+from d4pg_tpu.distributed.actor import ActorConfig, ActorWorker
+from d4pg_tpu.distributed.evaluator import Evaluator
+from d4pg_tpu.distributed.transport import (
+    TransitionReceiver,
+    TransitionSender,
+)
+
+__all__ = [
+    "WeightStore",
+    "ReplayService",
+    "ActorConfig",
+    "ActorWorker",
+    "Evaluator",
+    "TransitionReceiver",
+    "TransitionSender",
+]

@@ -1,0 +1,231 @@
+"""Actor workers: env stepping + exploration + transition streaming.
+
+Replaces the acting half of the reference's ``Worker``/``addExperienceToBuffer``
+(``main.py:137-185, 188-368``): where the reference steps one env with
+batch-1 inference and writes into a process-private buffer, the actor here
+steps a vectorized pool with one batched jit'd policy call per tick, folds
+n-step transitions, and streams them to the central replay service. Weights
+are pulled from the ``WeightStore`` when a new version appears (the
+reference pulls from shared memory every train call, ``ddpg.py:247``).
+
+Actors are stateless-restartable: everything an actor owns (envs, noise,
+n-step window) is rebuilt on restart; replay and weights live with the
+learner (SURVEY.md §5 failure-detection note).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from d4pg_tpu.envs.her import her_relabel
+from d4pg_tpu.envs.vector import EnvPool
+from d4pg_tpu.envs.wrappers import flatten_goal_obs
+from d4pg_tpu.learner.state import D4PGConfig
+from d4pg_tpu.learner.update import act
+from d4pg_tpu.distributed.replay_service import ReplayService
+from d4pg_tpu.distributed.weights import WeightStore
+from d4pg_tpu.replay.nstep import NStepFolder
+from d4pg_tpu.replay.uniform import TransitionBatch
+
+
+@dataclasses.dataclass
+class ActorConfig:
+    epsilon_0: float = 0.3  # the reference's live, never-decayed eps (C5)
+    min_epsilon: float = 0.01
+    epsilon_horizon: int = 5000  # episodes to decay over (random_process.py:13)
+    n_step: int = 3
+    gamma: float = 0.99
+    reward_scale: float = 1.0
+    weight_poll_every: int = 1  # pool ticks between version checks
+
+
+class _BaseActor:
+    """Weight-pulling + epsilon-decay machinery shared by actor kinds."""
+
+    def __init__(
+        self,
+        actor_id: str,
+        config: D4PGConfig,
+        actor_cfg: ActorConfig,
+        service: ReplayService,
+        weights: WeightStore,
+        seed: int = 0,
+    ):
+        self.actor_id = actor_id
+        self.config = config
+        self.cfg = actor_cfg
+        self.service = service
+        self.weights = weights
+        self._key = jax.random.key(seed)
+        self._version = 0
+        self._params = None
+        self._epsilon = actor_cfg.epsilon_0
+        self._episodes = 0
+        self._stop = threading.Event()
+        self.env_steps = 0
+
+    def _maybe_pull_weights(self) -> bool:
+        got = self.weights.get_if_newer(self._version)
+        if got is not None:
+            self._version, self._params = got
+            return True
+        return False
+
+    def _explore_actions(self, obs: np.ndarray) -> np.ndarray:
+        """Noisy policy actions for a [B, obs_dim] batch; uniform random
+        before the first weight publish (warmup, ``main.py:200-207``)."""
+        self._key, ka = jax.random.split(self._key)
+        if self._params is None:
+            return np.asarray(
+                jax.random.uniform(ka, (obs.shape[0], self.config.act_dim),
+                                   minval=-1.0, maxval=1.0)
+            )
+        return np.asarray(
+            act(self.config, self._params, jnp.asarray(obs), ka, self._epsilon)
+        )
+
+    def _decay_epsilon(self) -> None:
+        """eps = min + (eps0-min) * exp(-5k/horizon) on episode end — the
+        decay the reference defines but never runs (``random_process.py:
+        19-21``, call commented at ``main.py:366``)."""
+        self._episodes += 1
+        c = self.cfg
+        self._epsilon = c.min_epsilon + (c.epsilon_0 - c.min_epsilon) * float(
+            np.exp(-5.0 * self._episodes / c.epsilon_horizon)
+        )
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class ActorWorker(_BaseActor):
+    """Acting loop over a vectorized EnvPool with n-step folding.
+
+    ``run`` is resumable: the pool is reset once, and both the episode state
+    and the n-step window persist across calls — a cycle boundary in the
+    training loop must NOT restart episodes or drop pending window entries
+    (stale entries stitched across a reset would corrupt transitions).
+    """
+
+    def __init__(
+        self,
+        actor_id: str,
+        config: D4PGConfig,
+        actor_cfg: ActorConfig,
+        pool: EnvPool,
+        service: ReplayService,
+        weights: WeightStore,
+        seed: int = 0,
+    ):
+        super().__init__(actor_id, config, actor_cfg, service, weights, seed)
+        self.pool = pool
+        self._folder = NStepFolder(
+            actor_cfg.n_step, actor_cfg.gamma, pool.num_envs,
+            config.obs_dim, config.act_dim,
+        )
+        self._obs: np.ndarray | None = None
+
+    def run(self, max_steps: int) -> int:
+        """Collect ``max_steps`` pool ticks (E transitions per tick)."""
+        if self._obs is None:
+            self._obs = self.pool.reset()
+            self._folder.reset()
+        obs = self._obs
+        self._maybe_pull_weights()
+        for tick in range(max_steps):
+            if self._stop.is_set():
+                break
+            if tick % self.cfg.weight_poll_every == 0:
+                self._maybe_pull_weights()
+            actions = self._explore_actions(obs)
+            out = self.pool.step(actions)
+            folded = self._folder.step(
+                obs, actions, out.reward * self.cfg.reward_scale,
+                out.final_obs, out.terminated, out.truncated,
+            )
+            self.service.add(folded, actor_id=self.actor_id)
+            done_any = out.terminated | out.truncated
+            for _ in range(int(done_any.sum())):
+                self._decay_epsilon()
+            obs = out.obs
+            self.env_steps += self.pool.num_envs
+        self._obs = obs
+        return self.env_steps
+
+
+class GoalActorWorker(_BaseActor):
+    """Actor for goal-conditioned dict-obs envs with HER relabeling.
+
+    Rolls whole episodes on a single env, streams the original 1-step
+    transitions plus future-strategy relabels — the fixed version of
+    ``addExperienceToBuffer`` (``main.py:137-185``).
+    """
+
+    def __init__(
+        self,
+        actor_id: str,
+        config: D4PGConfig,
+        actor_cfg: ActorConfig,
+        env,
+        service: ReplayService,
+        weights: WeightStore,
+        her_ratio: float = 0.8,
+        rng_seed: int = 0,
+        seed: int = 0,
+    ):
+        super().__init__(actor_id, config, actor_cfg, service, weights, seed)
+        self.env = env
+        self.her_ratio = her_ratio
+        self._np_rng = np.random.default_rng(rng_seed)
+
+    def run_episode(self, max_steps: int) -> int:
+        env = self.env
+        self._maybe_pull_weights()
+        obs_dict, _ = env.reset()
+        raw_obs, achieved, actions, next_raw, rewards, dones = [], [], [], [], [], []
+        achieved.append(np.asarray(obs_dict["achieved_goal"], np.float32).copy())
+        for _ in range(max_steps):
+            flat = flatten_goal_obs(obs_dict)
+            a = self._explore_actions(flat[None])[0]
+            nobs_dict, r, term, trunc, info = env.step(a)
+            raw_obs.append(np.asarray(obs_dict["observation"], np.float32).copy())
+            actions.append(a)
+            next_raw.append(np.asarray(nobs_dict["observation"], np.float32).copy())
+            rewards.append(r)
+            done = bool(info.get("is_success", term))
+            dones.append(float(done))
+            achieved.append(np.asarray(nobs_dict["achieved_goal"], np.float32).copy())
+            obs_dict = nobs_dict
+            self.env_steps += 1
+            if done or term or trunc:
+                break
+        T = len(actions)
+        goal = np.asarray(obs_dict["desired_goal"], np.float32)
+        raw_obs_a = np.stack(raw_obs)
+        next_raw_a = np.stack(next_raw)
+        actions_a = np.stack(actions).astype(np.float32)
+        dones_a = np.asarray(dones, np.float32)
+        goal_tiled = np.tile(goal, (T, 1))
+        originals = TransitionBatch(
+            obs=np.concatenate([raw_obs_a, goal_tiled], -1).astype(np.float32),
+            action=actions_a,
+            reward=np.asarray(rewards, np.float32) * self.cfg.reward_scale,
+            next_obs=np.concatenate([next_raw_a, goal_tiled], -1).astype(np.float32),
+            done=dones_a,
+            discount=(self.cfg.gamma * (1.0 - dones_a)).astype(np.float32),
+        )
+        self.service.add(originals, actor_id=self.actor_id)
+        relabeled = her_relabel(
+            raw_obs_a, np.stack(achieved), actions_a, next_raw_a,
+            env.compute_reward, self._np_rng, self.her_ratio, self.cfg.gamma,
+        )
+        relabeled = relabeled._replace(
+            reward=relabeled.reward * self.cfg.reward_scale)
+        self.service.add(relabeled, actor_id=self.actor_id)
+        self._decay_epsilon()
+        return T

@@ -1,0 +1,86 @@
+"""Evaluator: periodic greedy rollouts against the latest published weights.
+
+Parity: the reference's evaluator process (``global_model_eval``,
+``main.py:103-134``): copy global weights, run a greedy episode, track the
+0.95/0.05 EWMA of returns, repeat — plus the per-cycle 10-trial eval with
+success-rate (``main.py:309-347``). Here the evaluator pulls from the
+``WeightStore`` (no shared memory) and reports through a metrics callback
+instead of appending to a process-local list the parent never sees
+(the reference's ``global_returns`` bug, SURVEY.md C17).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from d4pg_tpu.envs.wrappers import flatten_goal_obs, rescale_action
+from d4pg_tpu.learner.state import D4PGConfig
+from d4pg_tpu.learner.update import act_deterministic
+from d4pg_tpu.distributed.weights import WeightStore
+
+EWMA_OLD, EWMA_NEW = 0.95, 0.05  # main.py:131
+
+
+class Evaluator:
+    def __init__(
+        self,
+        config: D4PGConfig,
+        env_fn: Callable[[], object],
+        weights: WeightStore,
+        max_steps: int = 1000,
+        goal_conditioned: bool = False,
+    ):
+        self.config = config
+        self.env = env_fn()
+        self.weights = weights
+        self.max_steps = max_steps
+        self.goal_conditioned = goal_conditioned
+        self.ewma_return: Optional[float] = None
+        low = np.asarray(self.env.action_space.low, np.float32)
+        high = np.asarray(self.env.action_space.high, np.float32)
+        self._low, self._high = low, high
+
+    def _greedy_episode(self, params, seed: int | None = None) -> tuple[float, bool]:
+        reset_kw = {"seed": seed} if seed is not None else {}
+        obs, _ = self.env.reset(**reset_kw)
+        total, success = 0.0, False
+        for _ in range(self.max_steps):
+            flat = flatten_goal_obs(obs)
+            a = np.asarray(
+                act_deterministic(self.config, params, jnp.asarray(flat[None]))
+            )[0]
+            obs, r, term, trunc, info = self.env.step(
+                rescale_action(a, self._low, self._high)
+            )
+            total += float(r)
+            success = success or bool(info.get("is_success", False))
+            if term or trunc:
+                break
+        return total, success
+
+    def evaluate(self, n_trials: int = 10, seed: int | None = None) -> dict:
+        """Run n greedy trials; returns metrics incl. EWMA'd return and
+        success rate (``main.py:309-353``)."""
+        _, params = self.weights.get()
+        if params is None:
+            raise RuntimeError("no weights published yet")
+        returns, successes = [], []
+        for i in range(n_trials):
+            ep_seed = None if seed is None else seed + i
+            ret, suc = self._greedy_episode(params, ep_seed)
+            returns.append(ret)
+            successes.append(suc)
+        avg = float(np.mean(returns))
+        if self.ewma_return is None:
+            self.ewma_return = avg
+        else:
+            self.ewma_return = EWMA_OLD * self.ewma_return + EWMA_NEW * avg
+        return {
+            "avg_test_reward": avg,
+            "ewma_test_reward": self.ewma_return,
+            "success_rate": float(np.mean(successes)),
+            "learner_step": self.weights.step,
+        }

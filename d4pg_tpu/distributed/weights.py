@@ -1,0 +1,61 @@
+"""Versioned weight distribution from learner to actors/evaluator.
+
+Replaces the reference's shared-memory ``state_dict`` pulls
+(``sync_local_global`` ``ddpg.py:118-120``; evaluator copy
+``main.py:113-114``): the learner *publishes* actor params with a version
+number; actors/evaluators *pull* when they see a newer version. Host-side
+numpy copies keep the store process-agnostic (the same interface backs a
+DCN broadcast: publish serializes once, subscribers fetch).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import jax
+import numpy as np
+
+
+class WeightStore:
+    """Thread-safe versioned parameter store (single-writer, many-reader)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._version = 0
+        self._params: Any = None
+        self._step = 0
+
+    def publish(self, params: Any, step: int) -> int:
+        """Learner-side: publish new actor params (device arrays are pulled
+        to host numpy so readers never hold device references). Returns the
+        new version."""
+        host = jax.tree_util.tree_map(lambda x: np.asarray(x), params)
+        with self._lock:
+            self._version += 1
+            self._params = host
+            self._step = int(step)
+            return self._version
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    @property
+    def step(self) -> int:
+        """Learner step at last publish (replaces the shared global_count,
+        ``main.py:386``)."""
+        with self._lock:
+            return self._step
+
+    def get(self) -> tuple[int, Any]:
+        """Reader-side: (version, params) — params None until first publish."""
+        with self._lock:
+            return self._version, self._params
+
+    def get_if_newer(self, have_version: int) -> tuple[int, Any] | None:
+        with self._lock:
+            if self._version > have_version:
+                return self._version, self._params
+            return None

@@ -1,0 +1,35 @@
+"""Environment layer: gymnasium adapters, goal handling, HER, vector pools.
+
+Parity targets: ``NormalizeAction`` (``normalize_env.py:3-14``), the
+goal-conditioned dict-obs handling + HER relabeling hardwired into the
+reference's collection loop (``main.py:137-185``), and per-env value-support
+presets (``main.py:84-99``). All acting-side machinery is vectorized: the
+reference steps one env with batch-1 inference per step (SURVEY.md S3);
+here a pool of E envs steps in lockstep against one batched jit'd policy
+call.
+"""
+
+from d4pg_tpu.envs.wrappers import (
+    GoalObs,
+    flatten_goal_obs,
+    rescale_action,
+    RescaleActionWrapper,
+)
+from d4pg_tpu.envs.her import her_relabel
+from d4pg_tpu.envs.vector import EnvPool
+from d4pg_tpu.envs.presets import EnvPreset, PRESETS, get_preset
+from d4pg_tpu.envs.fake import FakeGoalEnv, PointMassEnv
+
+__all__ = [
+    "GoalObs",
+    "flatten_goal_obs",
+    "rescale_action",
+    "RescaleActionWrapper",
+    "her_relabel",
+    "EnvPool",
+    "EnvPreset",
+    "PRESETS",
+    "get_preset",
+    "FakeGoalEnv",
+    "PointMassEnv",
+]

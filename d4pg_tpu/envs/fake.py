@@ -1,0 +1,108 @@
+"""Fake environments for tests and benchmarks — no MuJoCo required.
+
+SURVEY.md §4: "a fake-env fixture so distributed tests need no MuJoCo".
+Two families:
+
+  - ``PointMassEnv``: dense-reward 2-D point mass with gym-style Box spaces;
+    a stand-in for the dense continuous-control configs.
+  - ``FakeGoalEnv``: goal-conditioned sparse-reward (-1/0) point mass with
+    dict observations and ``compute_reward``, the shape the reference's HER
+    loop assumes (``main.py:144-184``); a stand-in for Fetch/Adroit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class _Box:
+    def __init__(self, low, high, shape):
+        self.low = np.full(shape, low, np.float32)
+        self.high = np.full(shape, high, np.float32)
+        self.shape = shape
+
+
+class PointMassEnv:
+    """2-D point mass: action = acceleration, reward = -|pos| - 0.01|a|^2."""
+
+    def __init__(self, horizon: int = 100, seed: int = 0):
+        self.horizon = horizon
+        self.action_space = _Box(-1.0, 1.0, (2,))
+        self.observation_space = _Box(-np.inf, np.inf, (4,))
+        self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._pos = np.zeros(2, np.float32)
+        self._vel = np.zeros(2, np.float32)
+
+    def reset(self, seed=None, **kw):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._pos = self._rng.uniform(-1, 1, 2).astype(np.float32)
+        self._vel = np.zeros(2, np.float32)
+        self._t = 0
+        return self._obs(), {}
+
+    def _obs(self):
+        return np.concatenate([self._pos, self._vel]).astype(np.float32)
+
+    def step(self, action):
+        action = np.clip(np.asarray(action, np.float32), -1.0, 1.0)
+        self._vel = 0.9 * self._vel + 0.1 * action
+        self._pos = self._pos + self._vel
+        self._t += 1
+        reward = float(-np.linalg.norm(self._pos) - 0.01 * np.sum(action**2))
+        truncated = self._t >= self.horizon
+        return self._obs(), reward, False, truncated, {}
+
+    def close(self):
+        pass
+
+
+class FakeGoalEnv:
+    """Goal-conditioned point reach with sparse -1/0 reward and dict obs."""
+
+    def __init__(self, horizon: int = 50, tol: float = 0.15, seed: int = 0):
+        self.horizon = horizon
+        self.tol = tol
+        self.action_space = _Box(-1.0, 1.0, (2,))
+        self.observation_space = _Box(-np.inf, np.inf, (2,))
+        self.goal_dim = 2
+        self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._pos = np.zeros(2, np.float32)
+        self._goal = np.zeros(2, np.float32)
+
+    def compute_reward(self, achieved_goal, desired_goal, info=None):
+        """Sparse -1/0 (``env.compute_reward`` contract, ``main.py:177``).
+        Vectorized over leading dims."""
+        d = np.linalg.norm(
+            np.asarray(achieved_goal) - np.asarray(desired_goal), axis=-1
+        )
+        return -(d > self.tol).astype(np.float32)
+
+    def _obs(self):
+        return {
+            "observation": self._pos.copy(),
+            "achieved_goal": self._pos.copy(),
+            "desired_goal": self._goal.copy(),
+        }
+
+    def reset(self, seed=None, **kw):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._pos = self._rng.uniform(-1, 1, 2).astype(np.float32)
+        self._goal = self._rng.uniform(-1, 1, 2).astype(np.float32)
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        action = np.clip(np.asarray(action, np.float32), -1.0, 1.0)
+        self._pos = self._pos + 0.2 * action
+        self._t += 1
+        reward = float(self.compute_reward(self._pos, self._goal))
+        success = reward == 0.0
+        truncated = self._t >= self.horizon
+        return self._obs(), reward, bool(success), truncated, {"is_success": success}
+
+    def close(self):
+        pass

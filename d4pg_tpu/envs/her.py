@@ -1,0 +1,81 @@
+"""Hindsight Experience Replay: future-strategy relabeling.
+
+Parity: the reference's HER block (``main.py:154-184``): with probability
+``her_ratio`` per transition, substitute a goal achieved at a *future*
+timestep of the same episode for the desired goal, recompute the reward with
+the env's ``compute_reward``, and store the relabeled transition alongside
+the original.
+
+The reference has a bug here: the relabeled transition stores the Python
+loop variable ``action`` left over from the rollout (the episode's LAST
+action) instead of the transition's own ``episode_buffer[t][1]``
+(``main.py:184``). SURVEY.md §7 capability 7 mandates the fix — this
+implementation indexes every field by ``t``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from d4pg_tpu.replay.uniform import TransitionBatch
+
+
+def her_relabel(
+    observation: np.ndarray,  # [T, obs_dim]   raw (goal-free) observations
+    achieved_goal: np.ndarray,  # [T+1, goal_dim] achieved goals incl. final
+    action: np.ndarray,  # [T, act_dim]
+    next_observation: np.ndarray,  # [T, obs_dim]
+    compute_reward: Callable[..., np.ndarray],  # (ag, dg, info) GoalEnv API
+    rng: np.random.Generator,
+    her_ratio: float = 0.8,
+    gamma: float = 0.99,
+) -> TransitionBatch:
+    """Relabel an episode with future achieved goals.
+
+    For each selected t, draw k uniform in [t+1, T] (the reference draws
+    ``randint(t, T)+1`` i.e. future inclusive of the next step,
+    ``main.py:171-173``) and use ``achieved_goal[k]`` as the substitute
+    desired goal. Rewards are recomputed via ``compute_reward(achieved_goal
+    [t+1], new_goal)`` and transitions are terminal when the relabeled
+    reward indicates success (reward == 0 under the standard sparse
+    -1/0 convention, matching ``done = info['is_success']``,
+    ``main.py:148``).
+
+    Returns a TransitionBatch of ONLY the relabeled transitions, with policy
+    inputs already goal-concatenated ([obs, goal]) and ``discount`` folded
+    as gamma * (1 - done) (1-step; n-step folding happens upstream for the
+    originals, HER transitions are 1-step like the reference's).
+    """
+    T = action.shape[0]
+    sel = np.nonzero(rng.random(T) < her_ratio)[0]
+    if sel.size == 0:
+        obs_dim = observation.shape[-1] + achieved_goal.shape[-1]
+        z = np.zeros((0,), np.float32)
+        return TransitionBatch(
+            obs=np.zeros((0, obs_dim), np.float32),
+            action=np.zeros((0, action.shape[-1]), np.float32),
+            reward=z,
+            next_obs=np.zeros((0, obs_dim), np.float32),
+            done=z,
+            discount=z,
+        )
+    # future index k in [t+1, T] per selected t (vectorized)
+    k = rng.integers(sel + 1, T + 1)  # inclusive upper: achieved_goal has T+1 rows
+    new_goal = achieved_goal[k]  # [S, goal_dim]
+    # gymnasium-robotics GoalEnv signature: compute_reward(ag, dg, info)
+    reward = np.asarray(
+        compute_reward(achieved_goal[sel + 1], new_goal, None), np.float32
+    ).reshape(-1)
+    done = (reward == 0.0).astype(np.float32)  # sparse -1/0 success convention
+    return TransitionBatch(
+        obs=np.concatenate([observation[sel], new_goal], axis=-1).astype(np.float32),
+        action=action[sel].astype(np.float32),  # the t-indexed action (bug fix)
+        reward=reward,
+        next_obs=np.concatenate([next_observation[sel], new_goal], axis=-1).astype(
+            np.float32
+        ),
+        done=done,
+        discount=(gamma * (1.0 - done)).astype(np.float32),
+    )

@@ -1,0 +1,53 @@
+"""Per-environment presets: value support, reward scaling, horizons.
+
+Parity: the reference's ``configure_env_params`` hook (``main.py:84-99``,
+mostly commented out — only Pendulum's v_min=-300/v_max=0 survives,
+``main.py:86-88``) generalized into typed presets for the five
+``BASELINE.json`` benchmark configs (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvPreset:
+    env_id: str
+    v_min: float
+    v_max: float
+    n_atoms: int = 51
+    reward_scale: float = 1.0  # rewards are multiplied by this before replay
+    max_steps: int = 1000
+    n_step: int = 3
+    pixels: bool = False
+    goal_conditioned: bool = False
+
+
+PRESETS: dict[str, EnvPreset] = {
+    # reference preset (main.py:86-88)
+    "Pendulum-v1": EnvPreset(
+        "Pendulum-v1", v_min=-100.0, v_max=0.0, reward_scale=0.1, max_steps=200
+    ),
+    # BASELINE.md configs 2-5
+    "HalfCheetah-v4": EnvPreset("HalfCheetah-v4", v_min=0.0, v_max=1000.0),
+    "Humanoid-v4": EnvPreset("Humanoid-v4", v_min=0.0, v_max=800.0),
+    "cheetah-run-pixels": EnvPreset(
+        "cheetah-run-pixels", v_min=0.0, v_max=1000.0, pixels=True
+    ),
+    "AdroitHandDoor-v1": EnvPreset(
+        "AdroitHandDoor-v1", v_min=-100.0, v_max=300.0, goal_conditioned=False
+    ),
+    # goal-conditioned sparse-reward family for the HER path
+    "FetchReach-v2": EnvPreset(
+        "FetchReach-v2", v_min=-50.0, v_max=0.0, max_steps=50, n_step=1,
+        goal_conditioned=True,
+    ),
+}
+
+
+def get_preset(env_id: str) -> EnvPreset:
+    """Preset lookup with a permissive default (wide symmetric support)."""
+    if env_id in PRESETS:
+        return PRESETS[env_id]
+    return EnvPreset(env_id, v_min=-500.0, v_max=500.0)

@@ -1,0 +1,63 @@
+"""Action rescaling and goal-observation flattening.
+
+Parity: ``NormalizeAction`` (``normalize_env.py:3-14``) — the affine map
+between the policy's tanh range (-1, 1) and the env's ``[low, high]`` action
+box — and the dict-obs concatenation the reference hardwires into its
+collection loop (``state['observation']`` + ``state['desired_goal']``,
+``main.py:144``), here as an explicit, reusable adapter.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+
+def rescale_action(action: np.ndarray, low: np.ndarray, high: np.ndarray) -> np.ndarray:
+    """tanh range (-1, 1) -> [low, high] (``normalize_env.py:5-8``)."""
+    return low + (action + 1.0) * 0.5 * (high - low)
+
+
+def inverse_rescale_action(
+    action: np.ndarray, low: np.ndarray, high: np.ndarray
+) -> np.ndarray:
+    """[low, high] -> (-1, 1) (``normalize_env.py:10-14``)."""
+    return 2.0 * (action - low) / (high - low) - 1.0
+
+
+class RescaleActionWrapper:
+    """gymnasium wrapper form of ``rescale_action`` for single envs."""
+
+    def __init__(self, env):
+        self.env = env
+        self.low = np.asarray(env.action_space.low, np.float32)
+        self.high = np.asarray(env.action_space.high, np.float32)
+
+    def reset(self, **kw):
+        return self.env.reset(**kw)
+
+    def step(self, action):
+        return self.env.step(rescale_action(np.asarray(action), self.low, self.high))
+
+    def __getattr__(self, name):
+        return getattr(self.env, name)
+
+
+class GoalObs(NamedTuple):
+    """Structured goal-conditioned observation (gymnasium GoalEnv dict)."""
+
+    observation: np.ndarray
+    achieved_goal: np.ndarray
+    desired_goal: np.ndarray
+
+
+def flatten_goal_obs(obs) -> np.ndarray:
+    """Concatenate observation and desired goal into the policy input
+    (``main.py:144``). Accepts a GoalObs, a gymnasium dict, or a plain
+    array (returned unchanged)."""
+    if isinstance(obs, GoalObs):
+        return np.concatenate([obs.observation, obs.desired_goal], axis=-1)
+    if isinstance(obs, dict):
+        return np.concatenate([obs["observation"], obs["desired_goal"]], axis=-1)
+    return np.asarray(obs)

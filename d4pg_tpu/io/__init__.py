@@ -1,0 +1,19 @@
+"""IO: structured metrics bus -> TensorBoard, and Orbax checkpoint/resume.
+
+Parity: the reference's three overlapping logging mechanisms (TensorBoard
+scalars ``main.py:59-66, 352-353``; print telemetry ``main.py:349-350``;
+pickle train_logs, commented out, ``main.py:355-364``) unified behind one
+bus; and its save-only ``torch.save`` checkpointing (``main.py:367-368``)
+replaced by full-train-state Orbax checkpoints WITH a resume path
+(SURVEY.md §5: the reference has "no load path, no resume").
+"""
+
+from d4pg_tpu.io.metrics import CsvLogger, MetricsBus, TensorBoardSink
+from d4pg_tpu.io.checkpoint import CheckpointManager
+
+__all__ = [
+    "MetricsBus",
+    "TensorBoardSink",
+    "CsvLogger",
+    "CheckpointManager",
+]

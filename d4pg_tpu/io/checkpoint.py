@@ -1,0 +1,75 @@
+"""Orbax checkpointing of the FULL train state, with resume.
+
+The reference saves only actor/critic ``state_dict`` every cycle and has no
+load path at all (``main.py:367-368``, SURVEY.md C20). Here the checkpoint
+captures everything needed for exact resume (SURVEY.md §5 mandate): the
+complete ``D4PGState`` (params, targets, both optimizer states, PRNG key,
+step — the step also drives PER beta annealing, so that schedule resumes
+exactly) plus user metadata (env steps, episode count).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from d4pg_tpu.learner.state import D4PGState
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    @staticmethod
+    def _to_host(state: D4PGState) -> dict[str, Any]:
+        """Typed PRNG keys don't serialize as arrays; carry raw key data."""
+        d = state._asdict()
+        d["key"] = jax.random.key_data(d["key"])
+        return jax.tree_util.tree_map(np.asarray, d)
+
+    def save(self, state: D4PGState, extra: dict[str, Any] | None = None) -> None:
+        """Checkpoint at the state's own learner step."""
+        step = int(state.step)
+        payload = {
+            "state": self._to_host(state),
+            "extra": dict(extra or {}),
+        }
+        self._mgr.save(step, args=ocp.args.StandardSave(payload))
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    @property
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, template: D4PGState) -> tuple[D4PGState, dict[str, Any]]:
+        """Restore the latest checkpoint; ``template`` provides the pytree
+        structure/dtypes (a freshly init'd state)."""
+        step = self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self._dir}")
+        # Two passes: a raw restore recovers the (schema-free) extra dict,
+        # then a typed restore against the template rebuilds the real
+        # containers (optax NamedTuple states etc.) — a raw-only restore
+        # would hand back plain dicts that break continued training.
+        raw = self._mgr.restore(step)
+        target = {"state": self._to_host(template), "extra": raw["extra"]}
+        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(target))
+        d = restored["state"]
+        d["key"] = jax.random.wrap_key_data(d["key"])
+        return D4PGState(**d), dict(restored["extra"] or {})
+
+    def close(self) -> None:
+        self._mgr.close()

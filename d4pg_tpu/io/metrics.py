@@ -1,0 +1,88 @@
+"""Structured metrics bus with pluggable sinks.
+
+One ``log(step, {...})`` call fans out to every sink: TensorBoard (the
+reference's ``SummaryWriter`` scalars, ``main.py:17,66,352-353``), CSV (the
+shape its offline plots consume: ``(step, avg_return, curr_return)`` rows,
+``plots/plots.py:29-37``), and stdout. The bus is the "one structured
+metrics bus" SURVEY.md §5 mandates in place of the reference's three
+overlapping half-wired mechanisms.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import time
+from typing import Mapping, Protocol
+
+
+class MetricsSink(Protocol):
+    def write(self, step: int, metrics: Mapping[str, float]) -> None: ...
+    def close(self) -> None: ...
+
+
+class TensorBoardSink:
+    """TensorBoard scalars, lazily importing the writer."""
+
+    def __init__(self, log_dir: str):
+        from torch.utils.tensorboard import SummaryWriter  # baked-in torch
+
+        self._writer = SummaryWriter(log_dir)
+
+    def write(self, step: int, metrics: Mapping[str, float]) -> None:
+        for name, value in metrics.items():
+            self._writer.add_scalar(name, float(value), int(step))
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+class CsvLogger:
+    """CSV rows compatible with the reference's offline plotting
+    (``plots/plots.py:29-37`` reads ``step,avg_return,curr_return``)."""
+
+    def __init__(self, path: str, fieldnames: list[str]):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._file = open(path, "a", newline="")
+        self._writer = csv.writer(self._file)
+        self._fields = fieldnames
+
+    def write(self, step: int, metrics: Mapping[str, float]) -> None:
+        row = [step] + [metrics.get(f, "") for f in self._fields]
+        self._writer.writerow(row)
+        self._file.flush()
+
+    def close(self) -> None:
+        self._file.close()
+
+
+class _StdoutSink:
+    def write(self, step: int, metrics: Mapping[str, float]) -> None:
+        parts = " ".join(f"{k}={v:.4g}" for k, v in metrics.items())
+        print(f"[step {step}] {parts}", flush=True)
+
+    def close(self) -> None:
+        pass
+
+
+class MetricsBus:
+    def __init__(self, sinks: list | None = None, echo: bool = False):
+        self._sinks: list = list(sinks or [])
+        if echo:
+            self._sinks.append(_StdoutSink())
+        self._t0 = time.monotonic()
+
+    def add_sink(self, sink) -> None:
+        self._sinks.append(sink)
+
+    def log(self, step: int, metrics: Mapping[str, float]) -> None:
+        for sink in self._sinks:
+            sink.write(step, metrics)
+
+    @property
+    def elapsed(self) -> float:
+        return time.monotonic() - self._t0
+
+    def close(self) -> None:
+        for sink in self._sinks:
+            sink.close()

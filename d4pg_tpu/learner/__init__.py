@@ -1,0 +1,27 @@
+"""Learner core: train state + the single jit'd D4PG update.
+
+The reference's hot loop (``ddpg.py:200-255``, call stack SURVEY.md S2) spans
+torch autograd, a host-side numpy projection round-trip, shared-memory
+optimizers and python parameter loops. Here the entire update — target
+forward, Bellman projection, both losses, gradients, Adam, soft target
+update, TD-error outputs for PER — is ONE jit'd XLA computation; only replay
+sampling and priority writes stay on host.
+"""
+
+from d4pg_tpu.learner.state import D4PGConfig, D4PGState, init_state
+from d4pg_tpu.learner.update import (
+    act,
+    act_deterministic,
+    make_update,
+    update_step,
+)
+
+__all__ = [
+    "D4PGConfig",
+    "D4PGState",
+    "init_state",
+    "act",
+    "act_deterministic",
+    "make_update",
+    "update_step",
+]

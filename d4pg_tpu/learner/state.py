@@ -1,0 +1,125 @@
+"""D4PG train state: one pytree carrying everything the update needs.
+
+Replaces the reference's scattered mutable state — actor/critic + target
+copies as four nn.Modules (``ddpg.py:57-64``), two (dead) local Adams
+(``ddpg.py:67-68``), the global ``SharedAdam`` pair living in OS shared
+memory (``shared_adam.py:3-17``, ``main.py:384-385``), and the shared step
+counter (``main.py:386``) — with a single immutable pytree that is donated
+through the jit'd update and checkpointed atomically by Orbax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax import Array
+
+from d4pg_tpu.core.distribution import CategoricalSupport
+from d4pg_tpu.core.updates import hard_update
+from d4pg_tpu.models.actor import Actor
+from d4pg_tpu.models.critic import CategoricalCritic, MixtureOfGaussianCritic
+from d4pg_tpu.models.encoder import PixelActor, PixelCategoricalCritic
+
+
+@dataclasses.dataclass(frozen=True)
+class D4PGConfig:
+    """Static (hashable) configuration closed over by the jit'd update.
+
+    Defaults mostly mirror the reference's (``main.py:33-49``,
+    ``ddpg.py:81-87``): tau 0.001, gamma 0.99, 51 atoms. DOCUMENTED
+    DIVERGENCE: the reference runs Adam with betas (0.9, 0.9) at lr 1e-3
+    (``shared_adam.py:4``, ``main.py:384``). The fast-decaying second moment
+    makes effective steps so large the tanh actor slams into saturation and
+    its gradient vanishes (verified: on a known-optimum bandit the actor
+    sticks at a=1.0 and never recovers; with b2=0.999 it converges). We
+    default to standard b2=0.999 and actor lr 1e-4; set
+    ``adam_b2=0.9, lr_actor=1e-3`` for strict reference parity.
+    ``critic_family`` selects the distribution head: 'categorical' (live in
+    the reference) or 'mog' (its empty TODO stub, implemented for real
+    here).
+    """
+
+    obs_dim: int
+    act_dim: int
+    v_min: float = -300.0
+    v_max: float = 0.0
+    n_atoms: int = 51
+    hidden: Sequence[int] = (256, 256, 256)
+    critic_family: str = "categorical"  # 'categorical' | 'mog'
+    n_components: int = 5  # MoG components
+    lr_actor: float = 1e-4
+    lr_critic: float = 1e-3
+    adam_b1: float = 0.9
+    adam_b2: float = 0.999
+    tau: float = 0.001
+    gamma: float = 0.99
+    pixels: bool = False  # conv-encoder path (BASELINE.md config #4)
+    obs_shape: tuple = ()  # [H, W, C] when pixels=True
+    mog_samples: int = 32
+
+    def __post_init__(self):
+        object.__setattr__(self, "hidden", tuple(self.hidden))
+        object.__setattr__(self, "obs_shape", tuple(self.obs_shape))
+        if self.critic_family not in ("categorical", "mog"):
+            raise ValueError(f"unknown critic_family {self.critic_family!r}")
+
+    @property
+    def support(self) -> CategoricalSupport:
+        return CategoricalSupport(self.v_min, self.v_max, self.n_atoms)
+
+    def build_actor(self) -> nn.Module:
+        if self.pixels:
+            return PixelActor(self.act_dim, hidden=self.hidden)
+        return Actor(self.act_dim, hidden=self.hidden)
+
+    def build_critic(self) -> nn.Module:
+        if self.critic_family == "mog":
+            return MixtureOfGaussianCritic(self.n_components, hidden=self.hidden)
+        if self.pixels:
+            return PixelCategoricalCritic(self.n_atoms, hidden=self.hidden)
+        return CategoricalCritic(self.n_atoms, hidden=self.hidden)
+
+    def optimizer(self, lr: float) -> optax.GradientTransformation:
+        return optax.adam(lr, b1=self.adam_b1, b2=self.adam_b2)
+
+    def dummy_obs(self) -> Array:
+        shape = self.obs_shape if self.pixels else (self.obs_dim,)
+        return jnp.zeros((1,) + tuple(shape), jnp.float32)
+
+
+class D4PGState(NamedTuple):
+    """The complete learner state; a pure pytree (jit/donate/checkpoint-able)."""
+
+    actor_params: Any
+    critic_params: Any
+    target_actor_params: Any
+    target_critic_params: Any
+    actor_opt_state: Any
+    critic_opt_state: Any
+    key: Array  # PRNG key threaded through MoG sampling / any stochastic op
+    step: Array  # int32 learner step counter (replaces shared global_count)
+
+
+def init_state(config: D4PGConfig, key: Array) -> D4PGState:
+    """Initialize networks, targets (hard-copied, ``ddpg.py:92-94``) and
+    optimizer states."""
+    k_actor, k_critic, k_state = jax.random.split(key, 3)
+    obs = config.dummy_obs()
+    act = jnp.zeros((1, config.act_dim), jnp.float32)
+    actor_params = config.build_actor().init(k_actor, obs)
+    critic_params = config.build_critic().init(k_critic, obs, act)
+    return D4PGState(
+        actor_params=actor_params,
+        critic_params=critic_params,
+        target_actor_params=hard_update(None, actor_params),
+        target_critic_params=hard_update(None, critic_params),
+        actor_opt_state=config.optimizer(config.lr_actor).init(actor_params),
+        critic_opt_state=config.optimizer(config.lr_critic).init(critic_params),
+        key=k_state,
+        step=jnp.zeros((), jnp.int32),
+    )

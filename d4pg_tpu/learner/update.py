@@ -1,0 +1,191 @@
+"""The D4PG update and action functions, as pure jittable transforms.
+
+Parity map to the reference's ``DDPG.train`` (``ddpg.py:200-255``, SURVEY.md
+S2), all fused into one XLA computation:
+
+  - target dist ``Z'(s', pi'(s'))``          ``ddpg.py:205-206``
+  - Bellman projection onto the support      ``ddpg.py:214`` (host numpy
+    there; MXU einsum here, ``core/distribution.py``)
+  - cross-entropy critic loss                ``ddpg.py:217``
+  - per-sample TD error for PER              ``ddpg.py:220-222``
+  - critic Adam step                         ``ddpg.py:229-232``
+  - policy loss ``-E[Z(s, pi(s))]``          ``ddpg.py:236-238``
+  - actor Adam step                          ``ddpg.py:241-244``
+  - soft target update (tau)                 ``ddpg.py:250, 110-116``
+  - step counter increment                   ``main.py:307``
+
+The hogwild machinery (``copy_gradients`` aliasing ``ddpg.py:104-108``,
+``sync_local_global`` ``ddpg.py:118-120``, ``SharedAdam``) has no equivalent:
+under pjit the gradients are all-reduced synchronously across the mesh's
+``data`` axis by XLA-inserted collectives, so every replica applies the same
+deterministic update (SURVEY.md §5 race-detection note).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import Array
+
+from d4pg_tpu.core import mog as mog_ops
+from d4pg_tpu.core.distribution import categorical_projection
+from d4pg_tpu.core.losses import (
+    categorical_td_loss,
+    expected_q,
+)
+from d4pg_tpu.core.updates import soft_update
+from d4pg_tpu.learner.state import D4PGConfig, D4PGState
+from d4pg_tpu.replay.uniform import TransitionBatch
+
+
+def _critic_loss_fn(
+    config: D4PGConfig,
+    critic_params: Any,
+    state: D4PGState,
+    batch: TransitionBatch,
+    is_weights: Array | None,
+    key: Array,
+) -> tuple[Array, Array]:
+    """Returns (scalar critic loss, per-sample TD error)."""
+    actor = config.build_actor()
+    critic = config.build_critic()
+    next_action = actor.apply(state.target_actor_params, batch.next_obs)
+
+    if config.critic_family == "mog":
+        target_params = critic.apply(
+            state.target_critic_params, batch.next_obs, next_action
+        )
+        target = mog_ops.mog_target(target_params, batch.reward, batch.discount)
+        pred = critic.apply(critic_params, batch.obs, batch.action)
+        return mog_ops.mog_td_loss(
+            pred, target, key, n_samples=config.mog_samples, weights=is_weights
+        )
+
+    target_probs = critic.apply(
+        state.target_critic_params, batch.next_obs, next_action
+    )
+    proj = jax.lax.stop_gradient(
+        categorical_projection(
+            config.support, target_probs, batch.reward, batch.discount
+        )
+    )
+    pred_probs = critic.apply(critic_params, batch.obs, batch.action)
+    return categorical_td_loss(proj, pred_probs, weights=is_weights)
+
+
+def _actor_loss_fn(
+    config: D4PGConfig,
+    actor_params: Any,
+    critic_params: Any,
+    batch: TransitionBatch,
+) -> Array:
+    """Negative expected Q through the (fixed) critic (``ddpg.py:236-238``)."""
+    actor = config.build_actor()
+    critic = config.build_critic()
+    action = actor.apply(actor_params, batch.obs)
+    if config.critic_family == "mog":
+        params = critic.apply(critic_params, batch.obs, action)
+        return -jnp.mean(mog_ops.mog_mean(params))
+    probs = critic.apply(critic_params, batch.obs, action)
+    return -jnp.mean(expected_q(config.support, probs))
+
+
+def update_step(
+    config: D4PGConfig,
+    state: D4PGState,
+    batch: TransitionBatch,
+    is_weights: Array | None = None,
+) -> tuple[D4PGState, dict[str, Array]]:
+    """One full D4PG update. Pure; jit with config static.
+
+    Returns the new state and a metrics dict containing scalar ``critic_loss``
+    / ``actor_loss`` / ``q_mean`` and the per-sample ``td_error`` vector (the
+    PER priority signal, ``ddpg.py:252-255``).
+    """
+    key, sub = jax.random.split(state.key)
+
+    # --- critic step -----------------------------------------------------
+    (critic_loss, td_error), critic_grads = jax.value_and_grad(
+        lambda p: _critic_loss_fn(config, p, state, batch, is_weights, sub),
+        has_aux=True,
+    )(state.critic_params)
+    critic_updates, critic_opt_state = config.optimizer(config.lr_critic).update(
+        critic_grads, state.critic_opt_state, state.critic_params
+    )
+    critic_params = optax.apply_updates(state.critic_params, critic_updates)
+
+    # --- actor step (through the *updated* critic, like the reference,
+    # which steps the critic optimizer before the policy loss) -------------
+    actor_loss, actor_grads = jax.value_and_grad(
+        lambda p: _actor_loss_fn(config, p, critic_params, batch)
+    )(state.actor_params)
+    actor_updates, actor_opt_state = config.optimizer(config.lr_actor).update(
+        actor_grads, state.actor_opt_state, state.actor_params
+    )
+    actor_params = optax.apply_updates(state.actor_params, actor_updates)
+
+    # --- soft target updates (tau, ``ddpg.py:110-116``) -------------------
+    new_state = D4PGState(
+        actor_params=actor_params,
+        critic_params=critic_params,
+        target_actor_params=soft_update(
+            state.target_actor_params, actor_params, config.tau
+        ),
+        target_critic_params=soft_update(
+            state.target_critic_params, critic_params, config.tau
+        ),
+        actor_opt_state=actor_opt_state,
+        critic_opt_state=critic_opt_state,
+        key=key,
+        step=state.step + 1,
+    )
+    metrics = {
+        "critic_loss": critic_loss,
+        "actor_loss": actor_loss,
+        "q_mean": -actor_loss,
+        "td_error": td_error,
+    }
+    return new_state, metrics
+
+
+def make_update(config: D4PGConfig, donate: bool = True, use_is_weights: bool = True):
+    """jit-compile the update with ``config`` closed over statically.
+
+    ``donate=True`` donates the input state's buffers so XLA updates
+    parameters in place (HBM-frugal). ``use_is_weights=False`` compiles the
+    uniform-replay variant without the weights operand.
+    """
+    if use_is_weights:
+        fn = lambda state, batch, w: update_step(config, state, batch, w)
+    else:
+        fn = lambda state, batch: update_step(config, state, batch, None)
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+
+@partial(jax.jit, static_argnums=(0,))
+def act(
+    config: D4PGConfig,
+    actor_params: Any,
+    obs: Array,
+    key: Array,
+    epsilon: Array | float = 0.3,
+) -> Array:
+    """Exploratory action: ``clip(pi(s) + eps * N(0, I), -1, 1)``
+    (``main.py:145-146`` with the Gaussian noise of ``random_process.py:16-18``).
+
+    Batched: obs [B, obs_dim] -> actions [B, act_dim]; one key for the whole
+    batch (split upstream per actor for decorrelation).
+    """
+    action = config.build_actor().apply(actor_params, obs)
+    noise = jax.random.normal(key, action.shape) * epsilon
+    return jnp.clip(action + noise, -1.0, 1.0)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def act_deterministic(config: D4PGConfig, actor_params: Any, obs: Array) -> Array:
+    """Greedy action for evaluation (``main.py:121-130``)."""
+    return config.build_actor().apply(actor_params, obs)

@@ -1,0 +1,83 @@
+"""Synchronous data-parallel learner over the device mesh.
+
+Replaces the reference's entire distributed-update machinery — grad aliasing
+into shared tensors (``ddpg.py:104-108``), racy ``SharedAdam.step()`` from N
+processes (``shared_adam.py``), weight pull-back (``ddpg.py:118-120``) and
+the 1/n_workers lr rescale (``main.py:384-385``) — with the GSPMD
+formulation: the train state carries a replicated sharding, the batch is
+sharded over the ``data`` axis, and the SAME ``update_step`` used single-chip
+is jit'd with those shardings. ``jnp.mean`` over the global batch inside the
+loss becomes an XLA all-reduce over ICI; every replica then applies an
+identical Adam update — synchronous, deterministic, race-free by
+construction (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from d4pg_tpu.learner.state import D4PGConfig, D4PGState
+from d4pg_tpu.learner.update import update_step
+from d4pg_tpu.replay.uniform import TransitionBatch
+
+from d4pg_tpu.parallel.mesh import DATA_AXIS
+
+
+def _replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def _batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicate_state(state: D4PGState, mesh: Mesh) -> D4PGState:
+    """Place the train state fully replicated over the mesh."""
+    return jax.device_put(state, _replicated(mesh))
+
+
+def shard_batch(batch: TransitionBatch, mesh: Mesh) -> TransitionBatch:
+    """Shard a host batch over the ``data`` axis (leading dim split across
+    the mesh's data dimension). The batch size must divide evenly."""
+    return jax.device_put(batch, _batch_sharding(mesh))
+
+
+def make_sharded_update(
+    config: D4PGConfig,
+    mesh: Mesh,
+    donate: bool = True,
+    use_is_weights: bool = True,
+):
+    """jit the D4PG update with explicit shardings over ``mesh``.
+
+    in: state replicated, batch + IS weights sharded over ``data``.
+    out: state replicated, scalar metrics replicated, per-sample
+    ``td_error`` sharded over ``data`` (it flows back to the host PER
+    priority update, ``ddpg.py:252-255``).
+    """
+    repl = _replicated(mesh)
+    shard = _batch_sharding(mesh)
+
+    # Shardings as pytree prefixes: a single sharding broadcasts to the tree.
+    in_shardings: tuple
+    out_metrics = {
+        "critic_loss": repl,
+        "actor_loss": repl,
+        "q_mean": repl,
+        "td_error": shard,
+    }
+    if use_is_weights:
+        fn = lambda state, batch, w: update_step(config, state, batch, w)
+        in_shardings = (repl, shard, shard)
+    else:
+        fn = lambda state, batch: update_step(config, state, batch, None)
+        in_shardings = (repl, shard)
+    return jax.jit(
+        fn,
+        in_shardings=in_shardings,
+        out_shardings=(repl, out_metrics),
+        donate_argnums=(0,) if donate else (),
+    )

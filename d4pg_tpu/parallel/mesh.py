@@ -1,0 +1,50 @@
+"""Device mesh construction.
+
+One place decides the mesh geometry for the whole framework: a ``data`` axis
+for batch sharding (the D4PG learner's axis) and a ``model`` axis reserved
+for activation/weight sharding of larger trunks (SURVEY.md §2: "the mesh
+axis layout should be designed in from day one"). On a real slice the mesh
+axes ride ICI; under ``xla_force_host_platform_device_count`` the same code
+runs on virtual CPU devices for tests and the driver's multichip dry-run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Mesh geometry: data_parallel x model_parallel devices."""
+
+    data_parallel: int = -1  # -1: all remaining devices
+    model_parallel: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int]:
+        mp = max(1, self.model_parallel)
+        dp = self.data_parallel
+        if dp == -1:
+            if n_devices % mp:
+                raise ValueError(f"{n_devices} devices not divisible by model_parallel={mp}")
+            dp = n_devices // mp
+        if dp * mp != n_devices:
+            raise ValueError(
+                f"mesh {dp}x{mp} != {n_devices} devices; fix MeshSpec"
+            )
+        return dp, mp
+
+
+def make_mesh(spec: MeshSpec = MeshSpec(), devices=None) -> Mesh:
+    """Build the (data, model) mesh over the given (default: all) devices."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    dp, mp = spec.resolve(len(devices))
+    arr = np.asarray(devices).reshape(dp, mp)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))

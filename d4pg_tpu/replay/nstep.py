@@ -39,6 +39,12 @@ class NStepFolder:
         self._count = np.zeros(num_envs, np.int64)
         self._pow = self.gamma ** np.arange(n, dtype=np.float32)
 
+    def reset(self) -> None:
+        """Drop all pending window entries (call when the envs reset outside
+        the folder's view — e.g. a new acting cycle after a hard pool reset;
+        stale entries would otherwise be stitched across the boundary)."""
+        self._count[:] = 0
+
     def _fold_tail(self, e: int, next_obs_e: np.ndarray, done: float, out: list):
         """Emit all pending entries of env e against next_obs_e."""
         c = int(self._count[e])

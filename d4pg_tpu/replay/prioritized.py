@@ -51,6 +51,8 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         return idx
 
     def sample_idx(self, batch_size: int) -> np.ndarray:
+        if self.size == 0:
+            raise ValueError("cannot sample from an empty buffer")
         total = self._sum.sum()
         if self.stratified:
             bounds = np.linspace(0.0, total, batch_size + 1)

@@ -1,0 +1,234 @@
+"""Training driver: ``python -m d4pg_tpu.train --env Pendulum-v1 ...``
+
+Parity: the reference's ``main.py`` orchestration (SURVEY.md S1/C15): the
+HER-paper-shaped loop — epochs x cycles x (collect episodes + train steps)
+with per-cycle eval, TensorBoard logging and checkpointing
+(``main.py:299-368``) — rebuilt around the decoupled TPU runtime:
+
+  - actors collect into the central ``ReplayService`` (vectorized pool,
+    batched jit inference) instead of per-process buffers;
+  - the learner runs the single jit'd (optionally mesh-sharded) update;
+  - weights flow learner -> actors via the versioned ``WeightStore``
+    instead of shared-memory state_dict pulls;
+  - checkpoints are full-state Orbax saves with ``--resume 1`` restore
+    (the reference can only save, ``main.py:367-368``).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from d4pg_tpu.config import ExperimentConfig, parse_args
+from d4pg_tpu.distributed import (
+    ActorConfig,
+    ActorWorker,
+    Evaluator,
+    ReplayService,
+    WeightStore,
+)
+from d4pg_tpu.distributed.actor import GoalActorWorker
+from d4pg_tpu.envs import EnvPool, FakeGoalEnv, PointMassEnv, get_preset
+from d4pg_tpu.io import CheckpointManager, CsvLogger, MetricsBus, TensorBoardSink
+from d4pg_tpu.learner import init_state, make_update
+from d4pg_tpu.parallel import (
+    MeshSpec,
+    make_mesh,
+    make_sharded_update,
+    replicate_state,
+    shard_batch,
+)
+from d4pg_tpu.replay import LinearSchedule, PrioritizedReplayBuffer, ReplayBuffer
+
+
+def make_env_fn(cfg: ExperimentConfig, seed: int):
+    """Build one env instance; gymnasium by id, with fake-env fallbacks for
+    ids 'point' and 'fake-goal' (tests/smoke, SURVEY.md §4)."""
+    if cfg.env == "point":
+        return lambda: PointMassEnv(horizon=cfg.max_steps, seed=seed)
+    if cfg.env == "fake-goal":
+        return lambda: FakeGoalEnv(horizon=cfg.max_steps, seed=seed)
+    import gymnasium as gym
+
+    return lambda: gym.make(cfg.env)
+
+
+def infer_dims(cfg: ExperimentConfig) -> tuple[int, int]:
+    """obs/act dims, goal-concatenated for HER envs (``main.py:73-80``)."""
+    env = make_env_fn(cfg, seed=0)()
+    try:
+        if cfg.her:
+            obs, _ = env.reset(seed=0)
+            obs_dim = obs["observation"].shape[-1] + obs["desired_goal"].shape[-1]
+        else:
+            obs_dim = int(np.prod(env.observation_space.shape))
+        act_dim = int(np.prod(env.action_space.shape))
+    finally:
+        env.close()
+    return obs_dim, act_dim
+
+
+def train(cfg: ExperimentConfig) -> dict:
+    cfg = cfg.resolve()
+    run_dir = os.path.join(cfg.log_dir, cfg.run_name())
+    os.makedirs(run_dir, exist_ok=True)
+
+    obs_dim, act_dim = infer_dims(cfg)
+    config = cfg.learner_config(obs_dim, act_dim)
+
+    # --- learner state + update (single-device or sharded) ----------------
+    state = init_state(config, jax.random.key(cfg.seed))
+    mesh = None
+    if cfg.data_parallel > 1:
+        mesh = make_mesh(MeshSpec(data_parallel=cfg.data_parallel))
+        state = replicate_state(state, mesh)
+        update = make_sharded_update(config, mesh, donate=True,
+                                     use_is_weights=cfg.prioritized_replay)
+    else:
+        update = make_update(config, donate=True,
+                             use_is_weights=cfg.prioritized_replay)
+
+    # --- replay + schedule ------------------------------------------------
+    if cfg.prioritized_replay:
+        buffer = PrioritizedReplayBuffer(cfg.memory_size, obs_dim, act_dim,
+                                         alpha=cfg.per_alpha, seed=cfg.seed)
+    else:
+        buffer = ReplayBuffer(cfg.memory_size, obs_dim, act_dim, seed=cfg.seed)
+    beta = LinearSchedule(cfg.per_beta_steps, 1.0, cfg.per_beta0)
+    service = ReplayService(buffer)
+
+    # --- io ---------------------------------------------------------------
+    bus = MetricsBus(echo=True)
+    try:
+        bus.add_sink(TensorBoardSink(run_dir))
+    except Exception as e:  # tensorboard optional at runtime
+        print(f"tensorboard disabled: {e}")
+    bus.add_sink(CsvLogger(os.path.join(run_dir, "returns.csv"),
+                           ["avg_test_reward", "ewma_test_reward"]))
+    ckpt = CheckpointManager(os.path.join(run_dir, "ckpt"))
+    extra: dict = {"env_steps": 0}
+    if cfg.resume and ckpt.latest_step is not None:
+        state, extra = ckpt.restore(state if mesh is None else jax.device_get(state))
+        if mesh is not None:
+            state = replicate_state(state, mesh)
+        service.set_env_steps(extra.get("env_steps", 0))
+        print(f"resumed from step {int(state.step)} "
+              f"({service.env_steps} env steps)")
+
+    # --- actors + evaluator ----------------------------------------------
+    weights = WeightStore()
+    weights.publish(
+        state.actor_params if mesh is None else jax.device_get(state.actor_params),
+        step=int(jax.device_get(state.step)),
+    )
+    actor_cfg = ActorConfig(
+        epsilon_0=cfg.epsilon_0, min_epsilon=cfg.min_epsilon,
+        epsilon_horizon=cfg.epsilon_horizon, n_step=cfg.n_steps,
+        gamma=cfg.gamma, reward_scale=cfg.reward_scale,
+    )
+    actors = []
+    for w in range(cfg.n_workers):
+        if cfg.her:
+            actor = GoalActorWorker(
+                f"actor-{w}", config, actor_cfg,
+                make_env_fn(cfg, seed=cfg.seed + w)(), service, weights,
+                her_ratio=cfg.her_ratio, rng_seed=cfg.seed + w, seed=cfg.seed + w,
+            )
+        else:
+            pool = EnvPool(
+                [make_env_fn(cfg, seed=cfg.seed + w * cfg.num_envs + i)
+                 for i in range(cfg.num_envs)],
+                seed=cfg.seed + w,
+            )
+            actor = ActorWorker(f"actor-{w}", config, actor_cfg, pool, service,
+                                weights, seed=cfg.seed + w)
+        actors.append(actor)
+    evaluator = Evaluator(config, make_env_fn(cfg, seed=cfg.seed + 777), weights,
+                          max_steps=cfg.max_steps, goal_conditioned=cfg.her)
+
+    # --- warmup (main.py:200-207) ----------------------------------------
+    warmup_ticks = max(1, cfg.warmup // max(1, cfg.num_envs))
+    for actor in actors:
+        if cfg.her:
+            while actor.env_steps < cfg.warmup // cfg.n_workers:
+                actor.run_episode(cfg.max_steps)
+        else:
+            actor.run(warmup_ticks // cfg.n_workers)
+    service.flush()
+    print(f"warmup done: {len(service)} transitions")
+
+    # --- the HER-paper loop (main.py:299-368) ----------------------------
+    def publish():
+        p = state.actor_params if mesh is None else jax.device_get(state.actor_params)
+        weights.publish(p, step=int(jax.device_get(state.step)))
+
+    last_metrics: dict = {}
+    for epoch in range(cfg.n_epochs):
+        for cycle in range(cfg.n_cycles):
+            # collect
+            for actor in actors:
+                if cfg.her:
+                    for _ in range(cfg.episodes_per_cycle):
+                        actor.run_episode(cfg.max_steps)
+                else:
+                    ticks = cfg.episodes_per_cycle * cfg.max_steps // max(
+                        1, cfg.num_envs)
+                    actor.run(ticks)
+            service.flush()
+            # train
+            for _ in range(cfg.train_steps_per_cycle):
+                if cfg.prioritized_replay:
+                    step_now = int(jax.device_get(state.step))
+                    batch, w, idx = service.sample(cfg.batch_size,
+                                                   beta=beta.value(step_now))
+                    if mesh is not None:
+                        batch = shard_batch(batch, mesh)
+                        w = shard_batch(jnp.asarray(w), mesh)
+                    state, metrics = update(state, batch, jnp.asarray(w))
+                    service.update_priorities(
+                        idx, np.abs(np.asarray(metrics["td_error"])) + 1e-6)
+                else:
+                    batch = service.sample(cfg.batch_size)
+                    if mesh is not None:
+                        batch = shard_batch(batch, mesh)
+                    state, metrics = update(state, batch)
+            publish()
+            # eval + log (main.py:309-353)
+            eval_metrics = evaluator.evaluate(cfg.eval_trials,
+                                              seed=cfg.seed + epoch * 1000 + cycle)
+            last_metrics = {
+                "avg_test_reward": eval_metrics["avg_test_reward"],
+                "ewma_test_reward": eval_metrics["ewma_test_reward"],
+                "success_rate": eval_metrics["success_rate"],
+                "critic_loss": float(jax.device_get(metrics["critic_loss"])),
+                "actor_loss": float(jax.device_get(metrics["actor_loss"])),
+                "env_steps": service.env_steps,
+            }
+            bus.log(int(jax.device_get(state.step)), last_metrics)
+            if (cycle + 1) % cfg.checkpoint_every == 0:
+                ckpt.save(
+                    state if mesh is None else jax.device_get(state),
+                    extra={"env_steps": service.env_steps},
+                )
+    ckpt.wait()
+    bus.close()
+    service.close()
+    for actor in actors:
+        if cfg.her:
+            actor.env.close()
+        else:
+            actor.pool.close()
+    return last_metrics
+
+
+def main(argv=None):
+    cfg = parse_args(argv)
+    result = train(cfg)
+    print("final:", result)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,19 +1,25 @@
 """Test configuration: force the CPU backend with 8 virtual XLA devices.
 
 Per SURVEY.md §4, multi-host/multi-chip behavior is tested on a simulated
-8-device CPU mesh (the driver separately dry-runs the multichip path). These
-env vars must be set before the first ``import jax`` anywhere in the test
-process, which pytest guarantees by importing conftest first.
+8-device CPU mesh (the driver separately dry-runs the multichip path).
+
+NOTE: on this image the ``JAX_PLATFORMS`` env var is IGNORED — the axon TPU
+plugin wins platform selection regardless. ``jax.config.update`` before the
+backend initializes is what actually works; ``XLA_FLAGS`` only needs to be
+set before the first backend-initializing jax call.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -1,0 +1,194 @@
+"""Distributed-runtime tests: weight store, replay service (ingest,
+heartbeats, backpressure), actor workers incl. the HER goal actor, the
+evaluator's EWMA, and the socket transport — all on fake envs, no MuJoCo
+(SURVEY.md §4)."""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from d4pg_tpu.distributed import (
+    ActorConfig,
+    ActorWorker,
+    Evaluator,
+    ReplayService,
+    TransitionReceiver,
+    TransitionSender,
+    WeightStore,
+)
+from d4pg_tpu.distributed.actor import GoalActorWorker
+from d4pg_tpu.envs import EnvPool, FakeGoalEnv, PointMassEnv
+from d4pg_tpu.learner import D4PGConfig, init_state
+from d4pg_tpu.replay import PrioritizedReplayBuffer, ReplayBuffer
+from d4pg_tpu.replay.uniform import TransitionBatch
+
+
+def _batch(n=8, obs_dim=4, act_dim=2):
+    rng = np.random.default_rng(0)
+    return TransitionBatch(
+        obs=rng.standard_normal((n, obs_dim)).astype(np.float32),
+        action=rng.standard_normal((n, act_dim)).astype(np.float32),
+        reward=np.ones(n, np.float32),
+        next_obs=rng.standard_normal((n, obs_dim)).astype(np.float32),
+        done=np.zeros(n, np.float32),
+        discount=np.full(n, 0.99, np.float32),
+    )
+
+
+def test_weight_store_versions():
+    ws = WeightStore()
+    assert ws.get() == (0, None)
+    v1 = ws.publish({"w": np.ones(3)}, step=10)
+    assert v1 == 1 and ws.step == 10
+    assert ws.get_if_newer(0)[0] == 1
+    assert ws.get_if_newer(1) is None
+
+
+def test_replay_service_ingest_and_counts():
+    svc = ReplayService(ReplayBuffer(100, 4, 2))
+    svc.add(_batch(8), actor_id="a0")
+    svc.add(_batch(8), actor_id="a1")
+    svc.flush()
+    assert len(svc) == 16
+    assert svc.env_steps == 16
+    batch = svc.sample(4)
+    assert batch.obs.shape == (4, 4)
+    assert svc.dead_actors() == []
+    svc.close()
+
+
+def test_replay_service_per_dispatch():
+    svc = ReplayService(PrioritizedReplayBuffer(100, 4, 2))
+    svc.add(_batch(8))
+    svc.flush()
+    batch, w, idx = svc.sample(4, beta=0.5)
+    assert w.shape == (4,) and idx.shape == (4,)
+    svc.update_priorities(idx, np.full(4, 2.0))
+    svc.close()
+
+
+def test_replay_service_heartbeat_timeout():
+    svc = ReplayService(ReplayBuffer(10, 4, 2), heartbeat_timeout=0.05)
+    svc.heartbeat("a0")
+    time.sleep(0.1)
+    assert svc.dead_actors() == ["a0"]
+    svc.heartbeat("a0")
+    assert svc.dead_actors() == []
+    svc.close()
+
+
+def test_actor_worker_streams_transitions():
+    config = D4PGConfig(obs_dim=4, act_dim=2, v_min=-5, v_max=0, n_atoms=11,
+                        hidden=(16, 16))
+    svc = ReplayService(ReplayBuffer(10_000, 4, 2))
+    ws = WeightStore()
+    state = init_state(config, jax.random.key(0))
+    ws.publish(state.actor_params, step=0)
+    pool = EnvPool([lambda s=i: PointMassEnv(horizon=20, seed=s) for i in range(4)])
+    actor = ActorWorker("a0", config, ActorConfig(n_step=3, gamma=0.99),
+                        pool, svc, ws, seed=1)
+    steps = actor.run(max_steps=40)
+    svc.flush()
+    assert steps == 160  # 40 ticks x 4 envs
+    assert len(svc) > 100  # n-step folding emits slightly fewer than steps
+    assert svc.env_steps == len(svc)
+    # epsilon decayed across episode boundaries (2 boundaries per env)
+    assert actor._epsilon < ActorConfig().epsilon_0
+    svc.close()
+
+
+def test_actor_run_resumes_across_cycles():
+    """Two run() calls must continue the same episodes (no pool re-reset, no
+    stale n-step window stitched across the boundary): transition count and
+    episode accounting match one combined run."""
+    config = D4PGConfig(obs_dim=4, act_dim=2, v_min=-5, v_max=0, n_atoms=11,
+                        hidden=(16, 16))
+    ws = WeightStore()
+    ws.publish(init_state(config, jax.random.key(0)).actor_params, step=0)
+
+    def collect(tick_chunks):
+        svc = ReplayService(ReplayBuffer(10_000, 4, 2))
+        pool = EnvPool([lambda s=i: PointMassEnv(horizon=20, seed=s)
+                        for i in range(2)], seed=0)
+        actor = ActorWorker("a", config, ActorConfig(n_step=3), pool, svc, ws,
+                            seed=5)
+        for ticks in tick_chunks:
+            actor.run(ticks)
+        svc.flush()
+        n, eps = len(svc), len(pool.episode_returns)
+        svc.close()
+        return n, eps
+
+    assert collect([10, 10]) == collect([20])
+
+
+def test_actor_without_weights_uses_random_policy():
+    config = D4PGConfig(obs_dim=4, act_dim=2, v_min=-5, v_max=0, n_atoms=11,
+                        hidden=(16, 16))
+    svc = ReplayService(ReplayBuffer(1000, 4, 2))
+    ws = WeightStore()  # never published
+    pool = EnvPool([lambda: PointMassEnv(horizon=20, seed=0)])
+    actor = ActorWorker("a0", config, ActorConfig(), pool, svc, ws)
+    actor.run(max_steps=10)
+    svc.flush()
+    assert len(svc) > 0
+    svc.close()
+
+
+def test_goal_actor_her_streams_relabels():
+    """Goal actor streams originals + HER relabels; relabeled fraction >0."""
+    obs_dim = 2 + 2  # observation + goal
+    config = D4PGConfig(obs_dim=obs_dim, act_dim=2, v_min=-50, v_max=0,
+                        n_atoms=11, hidden=(16, 16))
+    svc = ReplayService(ReplayBuffer(10_000, obs_dim, 2))
+    ws = WeightStore()
+    env = FakeGoalEnv(horizon=30, seed=0)
+    actor = GoalActorWorker("g0", config, ActorConfig(gamma=0.98), env, svc, ws,
+                            her_ratio=1.0, rng_seed=2)
+    T = actor.run_episode(max_steps=30)
+    svc.flush()
+    assert T > 0
+    # originals + relabels: exactly 2T rows with her_ratio=1.0
+    assert len(svc) == 2 * T
+    svc.close()
+
+
+def test_evaluator_ewma_and_success():
+    config = D4PGConfig(obs_dim=4, act_dim=2, v_min=-5, v_max=0, n_atoms=11,
+                        hidden=(16, 16))
+    ws = WeightStore()
+    ev = Evaluator(config, lambda: PointMassEnv(horizon=10, seed=7), ws,
+                   max_steps=10)
+    with pytest.raises(RuntimeError):
+        ev.evaluate(n_trials=1)
+    state = init_state(config, jax.random.key(0))
+    ws.publish(state.actor_params, step=5)
+    m1 = ev.evaluate(n_trials=2, seed=0)
+    assert m1["learner_step"] == 5
+    assert m1["avg_test_reward"] == m1["ewma_test_reward"]  # first call seeds EWMA
+    m2 = ev.evaluate(n_trials=2, seed=0)
+    expected = 0.95 * m1["ewma_test_reward"] + 0.05 * m2["avg_test_reward"]
+    np.testing.assert_allclose(m2["ewma_test_reward"], expected, rtol=1e-9)
+
+
+def test_socket_transport_roundtrip():
+    """Frames survive the wire; receiver feeds the service callback."""
+    svc = ReplayService(ReplayBuffer(1000, 4, 2))
+    recv = TransitionReceiver(lambda b, aid: svc.add(b, actor_id=aid),
+                              host="127.0.0.1")
+    sender = TransitionSender("127.0.0.1", recv.port, actor_id="remote-7")
+    sent = _batch(16)
+    sender.send(sent)
+    sender.send(_batch(16))
+    deadline = time.monotonic() + 5.0
+    while len(svc) < 32 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(svc) == 32
+    got = svc.buffer.gather(np.arange(16))
+    np.testing.assert_allclose(got.obs, sent.obs, atol=0)
+    np.testing.assert_allclose(got.discount, sent.discount, atol=0)
+    sender.close()
+    recv.close()
+    svc.close()

@@ -1,0 +1,119 @@
+"""Environment-layer tests: rescaling parity, goal flattening, HER
+relabeling (including the reference's stale-action bug fix), pool autoreset."""
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.envs import (
+    EnvPool,
+    FakeGoalEnv,
+    PointMassEnv,
+    flatten_goal_obs,
+    get_preset,
+    her_relabel,
+    rescale_action,
+)
+from d4pg_tpu.envs.wrappers import inverse_rescale_action
+
+
+def test_rescale_roundtrip(rng):
+    """Affine parity with NormalizeAction (normalize_env.py:3-14)."""
+    low, high = np.array([-2.0, 0.0]), np.array([2.0, 10.0])
+    a = rng.uniform(-1, 1, (16, 2))
+    scaled = rescale_action(a, low, high)
+    assert (scaled >= low - 1e-6).all() and (scaled <= high + 1e-6).all()
+    np.testing.assert_allclose(inverse_rescale_action(scaled, low, high), a, atol=1e-6)
+    # endpoints map exactly
+    np.testing.assert_allclose(rescale_action(np.array([-1.0, 1.0]), low, high),
+                               np.array([low[0], high[1]]))
+
+
+def test_flatten_goal_obs():
+    obs = {"observation": np.ones(3), "desired_goal": np.zeros(2),
+           "achieved_goal": np.ones(2)}
+    flat = flatten_goal_obs(obs)
+    assert flat.shape == (5,)
+    plain = np.arange(4.0)
+    np.testing.assert_array_equal(flatten_goal_obs(plain), plain)
+
+
+def test_her_relabel_uses_per_step_actions(rng):
+    """The bug fix for main.py:184: each relabeled transition must carry its
+    OWN action, not the episode's last."""
+    T, goal_dim = 20, 2
+    env = FakeGoalEnv(seed=0)
+    observation = rng.standard_normal((T, 2)).astype(np.float32)
+    achieved = rng.standard_normal((T + 1, goal_dim)).astype(np.float32)
+    # make actions identifiable: action[t] = [t, -t]
+    action = np.stack([np.arange(T), -np.arange(T)], axis=-1).astype(np.float32)
+    next_observation = rng.standard_normal((T, 2)).astype(np.float32)
+    batch = her_relabel(observation, achieved, action, next_observation,
+                        env.compute_reward, rng, her_ratio=1.0)
+    assert batch.obs.shape[0] == T
+    # recover t from the stored action's first coordinate; must be 0..T-1
+    ts = batch.action[:, 0].astype(int)
+    np.testing.assert_array_equal(np.sort(ts), np.arange(T))
+    # obs = [observation[t], goal]: first 2 dims match the t-indexed rows
+    np.testing.assert_allclose(batch.obs[:, :2], observation[ts], atol=0)
+
+
+def test_her_relabel_future_goals_and_success(rng):
+    """Goals must come from the episode's own future; achieved==goal at the
+    sampled index implies reward 0 and done."""
+    T = 10
+    env = FakeGoalEnv(seed=0)
+    achieved = np.linspace(0, 1, T + 1)[:, None].repeat(2, axis=1).astype(np.float32)
+    observation = np.zeros((T, 2), np.float32)
+    action = np.zeros((T, 2), np.float32)
+    next_observation = np.zeros((T, 2), np.float32)
+    batch = her_relabel(observation, achieved, action, next_observation,
+                        env.compute_reward, rng, her_ratio=1.0)
+    # rewards are in {-1, 0}; discount == 0 exactly where done
+    assert set(np.unique(batch.reward)).issubset({-1.0, 0.0})
+    np.testing.assert_array_equal(batch.discount == 0.0, batch.done == 1.0)
+    assert (batch.done == 1.0).any()
+
+
+def test_her_ratio_zero_empty(rng):
+    env = FakeGoalEnv(seed=0)
+    batch = her_relabel(np.zeros((5, 2), np.float32), np.zeros((6, 2), np.float32),
+                        np.zeros((5, 2), np.float32), np.zeros((5, 2), np.float32),
+                        env.compute_reward, rng, her_ratio=0.0)
+    assert batch.obs.shape[0] == 0
+
+
+def test_env_pool_autoreset_and_stats():
+    horizon = 25
+    pool = EnvPool([lambda s=i: PointMassEnv(horizon=horizon, seed=s)
+                    for i in range(4)], seed=0)
+    obs = pool.reset()
+    assert obs.shape == (4, 4)
+    steps = 0
+    for _ in range(horizon):
+        out = pool.step(np.zeros((4, 2), np.float32))
+        steps += 1
+    # all four envs truncated exactly at the horizon and auto-reset
+    assert len(pool.episode_returns) == 4
+    assert pool.episode_lengths == [horizon] * 4
+    assert out.truncated.all()
+    # final_obs differs from the post-reset obs on the done tick
+    assert not np.allclose(out.obs, out.final_obs)
+    pool.close()
+
+
+def test_fake_goal_env_contract():
+    env = FakeGoalEnv(seed=3)
+    obs, _ = env.reset(seed=3)
+    assert set(obs) == {"observation", "achieved_goal", "desired_goal"}
+    o2, r, term, trunc, info = env.step(np.array([0.5, 0.5]))
+    assert r in (-1.0, 0.0) and "is_success" in info
+    # vectorized compute_reward
+    r_vec = env.compute_reward(np.zeros((7, 2)), np.zeros((7, 2)))
+    np.testing.assert_array_equal(r_vec, np.zeros(7))
+
+
+def test_presets():
+    p = get_preset("Pendulum-v1")
+    assert p.v_max == 0.0 and p.v_min < 0
+    q = get_preset("SomeUnknownEnv-v9")
+    assert q.v_min < 0 < q.v_max

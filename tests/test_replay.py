@@ -253,3 +253,26 @@ def test_nstep_multi_env_independent():
     # env0: folded 2-step (1+2); env1: terminal flush of both entries
     rewards = sorted(out.reward.tolist())
     assert rewards == pytest.approx([3.0, 20.0, 30.0])
+
+
+def test_nstep_reset_drops_pending_windows():
+    """reset() must discard partial windows so nothing is stitched across a
+    hard env reset: after reset, the first n-1 steps emit nothing and the
+    first emitted transition starts from post-reset data."""
+    f = NStepFolder(n=3, gamma=0.9, num_envs=1, obs_dim=1, act_dim=1)
+    # two steps of a doomed episode (window partially filled)
+    for x in (1.0, 2.0):
+        out = f.step(np.array([[x]]), np.array([[x]]), np.array([x]),
+                     np.array([[x + 0.5]]), np.array([False]))
+        assert out.obs.shape[0] == 0
+    f.reset()
+    # refill from scratch: exactly n steps until the first emission
+    for x in (10.0, 20.0):
+        out = f.step(np.array([[x]]), np.array([[x]]), np.array([x]),
+                     np.array([[x + 0.5]]), np.array([False]))
+        assert out.obs.shape[0] == 0
+    out = f.step(np.array([[30.0]]), np.array([[30.0]]), np.array([30.0]),
+                 np.array([[30.5]]), np.array([False]))
+    assert out.obs.shape[0] == 1
+    assert out.obs[0, 0] == pytest.approx(10.0)  # post-reset head, not 1.0
+    assert out.reward[0] == pytest.approx(10.0 + 0.9 * 20.0 + 0.81 * 30.0)
