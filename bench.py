@@ -1,0 +1,145 @@
+"""Benchmark: D4PG learner grad-steps/sec on the available accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The metric is the north star from BASELINE.md: learner grad steps per
+second on the Humanoid-v4-sized D4PG config (obs 376, act 17, batch 256,
+51 atoms, 256-wide MLPs). ``vs_baseline`` is measured against the
+reference implementation's achievable update rate: the reference's train
+step is host-bound — its categorical projection runs a per-atom Python/
+NumPy loop on the host (``ddpg.py:142-185``) plus four network passes and
+optimizer steps in torch on CPU (the reference never uses CUDA;
+``utils.py:5`` is a comment). BASELINE.json publishes no numbers, so the
+baseline figure here is measured fresh each run with an equivalent
+torch-CPU step when torch is available, else a recorded constant.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BATCH = 256
+OBS_DIM, ACT_DIM = 376, 17  # Humanoid-v4 (BASELINE.md config #3)
+N_ATOMS = 51
+STEPS = 200
+# torch-CPU reference measurement recorded on this image (2026-07-29,
+# measured by bench_reference_torch_cpu below); fallback when the live
+# measurement is unavailable.
+RECORDED_BASELINE_SPS = 39.6
+
+
+def bench_tpu() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from d4pg_tpu.learner import D4PGConfig, init_state, make_update
+    from d4pg_tpu.replay.uniform import TransitionBatch
+
+    config = D4PGConfig(obs_dim=OBS_DIM, act_dim=ACT_DIM, v_min=0.0,
+                        v_max=800.0, n_atoms=N_ATOMS, hidden=(256, 256, 256))
+    state = init_state(config, jax.random.key(0))
+    update = make_update(config, donate=True, use_is_weights=True)
+
+    rng = np.random.default_rng(0)
+    done = (rng.random(BATCH) < 0.01).astype(np.float32)
+    batch = TransitionBatch(
+        obs=rng.standard_normal((BATCH, OBS_DIM)).astype(np.float32),
+        action=rng.uniform(-1, 1, (BATCH, ACT_DIM)).astype(np.float32),
+        reward=rng.standard_normal(BATCH).astype(np.float32),
+        next_obs=rng.standard_normal((BATCH, OBS_DIM)).astype(np.float32),
+        done=done,
+        discount=(0.99 * (1.0 - done)).astype(np.float32),
+    )
+    batch = jax.device_put(batch)
+    weights = jax.device_put(jnp.ones((BATCH,), jnp.float32))
+
+    # warmup/compile
+    state, metrics = update(state, batch, weights)
+    jax.block_until_ready(metrics["critic_loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, metrics = update(state, batch, weights)
+    jax.block_until_ready(metrics["critic_loss"])
+    dt = time.perf_counter() - t0
+    return STEPS / dt
+
+
+def bench_reference_torch_cpu(steps: int = 20) -> float | None:
+    """Measure an equivalent-shape reference-style step in torch on CPU:
+    4 MLP passes + host-side numpy categorical projection + 2 Adam steps,
+    mirroring the reference's ``DDPG.train`` data path (SURVEY.md S2)."""
+    try:
+        import torch
+    except Exception:
+        return None
+    torch.manual_seed(0)
+
+    def mlp(in_dim, out_dim):
+        return torch.nn.Sequential(
+            torch.nn.Linear(in_dim, 256), torch.nn.ReLU(),
+            torch.nn.Linear(256, 256), torch.nn.ReLU(),
+            torch.nn.Linear(256, 256), torch.nn.ReLU(),
+            torch.nn.Linear(256, out_dim),
+        )
+
+    actor, actor_t = mlp(OBS_DIM, ACT_DIM), mlp(OBS_DIM, ACT_DIM)
+    critic, critic_t = (mlp(OBS_DIM + ACT_DIM, N_ATOMS),
+                        mlp(OBS_DIM + ACT_DIM, N_ATOMS))
+    opt_a = torch.optim.Adam(actor.parameters(), lr=1e-3, betas=(0.9, 0.9))
+    opt_c = torch.optim.Adam(critic.parameters(), lr=1e-3, betas=(0.9, 0.9))
+
+    obs = torch.randn(BATCH, OBS_DIM)
+    act = torch.rand(BATCH, ACT_DIM) * 2 - 1
+    rew = np.random.randn(BATCH).astype(np.float64)
+    v_min, v_max = 0.0, 800.0
+    delta = (v_max - v_min) / (N_ATOMS - 1)
+    bins = np.linspace(v_min, v_max, N_ATOMS)
+
+    def step():
+        with torch.no_grad():
+            ta = torch.tanh(actor_t(obs))
+            tz = torch.softmax(critic_t(torch.cat([obs, ta], -1)), -1).numpy()
+        # reference-style per-atom host projection loop (ddpg.py:142-185)
+        proj = np.zeros_like(tz)
+        for j in range(N_ATOMS):
+            tzj = np.clip(rew + 0.99 * bins[j], v_min, v_max)
+            b = (tzj - v_min) / delta
+            l, u = np.floor(b).astype(int), np.ceil(b).astype(int)
+            eq = l == u
+            np.add.at(proj, (np.arange(BATCH), l),
+                      tz[:, j] * np.where(eq, 1.0, u - b))
+            np.add.at(proj, (np.arange(BATCH), u),
+                      tz[:, j] * np.where(eq, 0.0, b - l))
+        proj_t = torch.as_tensor(proj, dtype=torch.float32)
+        q = torch.softmax(critic(torch.cat([obs, act], -1)), -1)
+        loss_c = -(proj_t * torch.log(q + 1e-10)).sum(-1).mean()
+        opt_c.zero_grad(); loss_c.backward(); opt_c.step()
+        a = torch.tanh(actor(obs))
+        qa = torch.softmax(critic(torch.cat([obs, a], -1)), -1)
+        loss_a = -(qa * torch.as_tensor(bins, dtype=torch.float32)).sum(-1).mean()
+        opt_a.zero_grad(); loss_a.backward(); opt_a.step()
+
+    step()  # warmup
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        step()
+    return steps / (time.perf_counter() - t0)
+
+
+def main():
+    sps = bench_tpu()
+    baseline = bench_reference_torch_cpu() or RECORDED_BASELINE_SPS
+    print(json.dumps({
+        "metric": "learner_grad_steps_per_sec",
+        "value": round(sps, 2),
+        "unit": "steps/sec",
+        "vs_baseline": round(sps / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+"""Offline analysis: run loggers and EWMA return plots.
+
+Replaces the reference's ``plots/plots.py`` (CSV scan -> EWMA -> PNG) and
+the ``plotUtil.ipynb`` ``Logger`` class (named-series dict logs with pickle
+persistence and comparison plots) with importable, tested equivalents.
+"""
+
+from d4pg_tpu.analysis.ewma import ewma
+from d4pg_tpu.analysis.logger import RunLogger
+from d4pg_tpu.analysis.plots import load_returns_csv, plot_runs
+
+__all__ = ["ewma", "RunLogger", "load_returns_csv", "plot_runs"]

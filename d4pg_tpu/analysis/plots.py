@@ -1,0 +1,81 @@
+"""EWMA return plots from run CSVs.
+
+Parity: ``plots/plots.py:24-48`` — scan a directory for return CSVs, apply
+EWMA smoothing, write ``<name>.png`` — generalized to overlay multiple runs
+(the notebook's DDPG-vs-DistDDPG comparison, cell 1). Run as
+``python -m d4pg_tpu.analysis.plots <run_dir> [<run_dir> ...]``.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import sys
+
+import numpy as np
+
+from d4pg_tpu.analysis.ewma import ewma
+
+
+def load_returns_csv(path: str) -> tuple[np.ndarray, np.ndarray]:
+    """Read (step, avg_return[, ...]) rows; returns (steps, returns)."""
+    steps, rets = [], []
+    with open(path) as f:
+        for row in csv.reader(f):
+            if not row:
+                continue
+            try:
+                step, ret = float(row[0]), float(row[1])
+            except (ValueError, IndexError):
+                continue  # header or malformed row
+            steps.append(step)
+            rets.append(ret)
+    return np.asarray(steps), np.asarray(rets)
+
+
+def plot_runs(
+    runs: dict[str, tuple[np.ndarray, np.ndarray]],
+    out_path: str,
+    alpha: float = 0.95,
+    title: str = "returns",
+) -> str:
+    """Overlay EWMA-smoothed return curves; writes a PNG, returns its path."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(8, 5))
+    for name, (steps, rets) in sorted(runs.items()):
+        if len(steps) == 0:
+            continue
+        ax.plot(steps, ewma(rets, alpha), label=name)
+        ax.plot(steps, rets, alpha=0.2)
+    ax.set_xlabel("learner step")
+    ax.set_ylabel("avg test return (EWMA)")
+    ax.set_title(title)
+    ax.legend()
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return out_path
+
+
+def main(argv=None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: python -m d4pg_tpu.analysis.plots <run_dir> [...]")
+        raise SystemExit(2)
+    runs = {}
+    for run_dir in argv:
+        csv_path = os.path.join(run_dir, "returns.csv")
+        if os.path.exists(csv_path):
+            runs[os.path.basename(run_dir.rstrip("/"))] = load_returns_csv(csv_path)
+        else:
+            print(f"skip {run_dir}: no returns.csv")
+    out = plot_runs(runs, out_path="returns.png")
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -25,8 +25,9 @@ import numpy as np
 from d4pg_tpu.envs.her import her_relabel
 from d4pg_tpu.envs.vector import EnvPool
 from d4pg_tpu.envs.wrappers import flatten_goal_obs
+from d4pg_tpu.core.noise import ou
 from d4pg_tpu.learner.state import D4PGConfig
-from d4pg_tpu.learner.update import act
+from d4pg_tpu.learner.update import act, act_ou
 from d4pg_tpu.distributed.replay_service import ReplayService
 from d4pg_tpu.distributed.weights import WeightStore
 from d4pg_tpu.replay.nstep import NStepFolder
@@ -42,6 +43,18 @@ class ActorConfig:
     gamma: float = 0.99
     reward_scale: float = 1.0
     weight_poll_every: int = 1  # pool ticks between version checks
+    # Exploration process. The reference exposes --ou_theta/--ou_sigma/--ou_mu
+    # but never wires OU in (SURVEY.md C6 — constructed nowhere live); here
+    # noise='ou' actually runs the temporally-correlated process.
+    noise: str = "gaussian"  # 'gaussian' | 'ou'
+    ou_theta: float = 0.25
+    ou_sigma: float = 0.05
+    ou_mu: float = 0.0
+    ou_dt: float = 0.01
+
+    def __post_init__(self):
+        if self.noise not in ("gaussian", "ou"):
+            raise ValueError(f"unknown noise process {self.noise!r}")
 
 
 class _BaseActor:
@@ -66,6 +79,7 @@ class _BaseActor:
         self._params = None
         self._epsilon = actor_cfg.epsilon_0
         self._episodes = 0
+        self._ou = None  # lazily-sized OU state when cfg.noise == 'ou'
         self._stop = threading.Event()
         self.env_steps = 0
 
@@ -85,9 +99,25 @@ class _BaseActor:
                 jax.random.uniform(ka, (obs.shape[0], self.config.act_dim),
                                    minval=-1.0, maxval=1.0)
             )
+        if self.cfg.noise == "ou":
+            if self._ou is None or self._ou.x.shape[0] != obs.shape[0]:
+                self._ou = ou.init(self.config.act_dim, (obs.shape[0],))
+            actions, self._ou = act_ou(
+                self.config, self._params, jnp.asarray(obs), self._ou, ka,
+                epsilon=self._epsilon, theta=self.cfg.ou_theta,
+                mu=self.cfg.ou_mu, sigma=self.cfg.ou_sigma, dt=self.cfg.ou_dt,
+            )
+            return np.asarray(actions)
         return np.asarray(
             act(self.config, self._params, jnp.asarray(obs), ka, self._epsilon)
         )
+
+    def _reset_noise(self, done_mask: np.ndarray) -> None:
+        """Zero the OU state of envs whose episode ended
+        (``random_process.py:41-45`` resets x on episode reset)."""
+        if self._ou is not None and done_mask.any():
+            keep = jnp.asarray(~done_mask, jnp.float32)[:, None]
+            self._ou = self._ou._replace(x=self._ou.x * keep)
 
     def _decay_epsilon(self) -> None:
         """eps = min + (eps0-min) * exp(-5k/horizon) on episode end — the
@@ -150,6 +180,7 @@ class ActorWorker(_BaseActor):
             )
             self.service.add(folded, actor_id=self.actor_id)
             done_any = out.terminated | out.truncated
+            self._reset_noise(done_any)
             for _ in range(int(done_any.sum())):
                 self._decay_epsilon()
             obs = out.obs
@@ -227,5 +258,6 @@ class GoalActorWorker(_BaseActor):
         relabeled = relabeled._replace(
             reward=relabeled.reward * self.cfg.reward_scale)
         self.service.add(relabeled, actor_id=self.actor_id)
+        self._reset_noise(np.array([True]))  # episode boundary: zero OU state
         self._decay_epsilon()
         return T

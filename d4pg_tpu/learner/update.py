@@ -189,3 +189,33 @@ def act(
 def act_deterministic(config: D4PGConfig, actor_params: Any, obs: Array) -> Array:
     """Greedy action for evaluation (``main.py:121-130``)."""
     return config.build_actor().apply(actor_params, obs)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def act_ou(
+    config: D4PGConfig,
+    actor_params: Any,
+    obs: Array,
+    ou_state,
+    key: Array,
+    epsilon: Array | float = 1.0,
+    theta: float = 0.25,
+    mu: float = 0.0,
+    sigma: float = 0.05,
+    dt: float = 0.01,
+):
+    """Exploratory action with Ornstein-Uhlenbeck noise, fused into one jit:
+    greedy forward + OU state advance + clip in a single dispatch (the
+    temporally-correlated process of ``random_process.py:23-45``, which the
+    reference constructs nowhere live — SURVEY.md C6).
+
+    Returns ``(actions, new_ou_state)``; thread the state through the acting
+    loop and zero rows at episode boundaries.
+    """
+    from d4pg_tpu.core.noise import ou
+
+    greedy = config.build_actor().apply(actor_params, obs)
+    new_state, noise = ou.sample(ou_state, key, theta=theta, mu=mu,
+                                 sigma=sigma, dt=dt)
+    action = jnp.clip(greedy + epsilon * noise, -1.0, 1.0)
+    return action, new_state

@@ -1,0 +1,11 @@
+"""Hand-written TPU kernels (Pallas).
+
+The compute path is XLA-compiled JAX; these kernels cover the few ops where
+explicit fusion/layout control beats the compiler. Each kernel has a
+reference JAX formulation it is tested against, and callers can select the
+implementation (``method='einsum' | 'pallas'``).
+"""
+
+from d4pg_tpu.ops.projection import projection_pallas
+
+__all__ = ["projection_pallas"]

@@ -1,0 +1,97 @@
+"""Pallas TPU kernel for the categorical Bellman projection.
+
+Fuses the whole projection — Bellman map, clip, interpolation-weight
+construction, and the contraction over source atoms — into one VMEM-resident
+kernel, so the [B, A, A] weight tensor never exists outside on-chip memory.
+The reference computes this on the HOST with a per-atom Python loop and
+numpy scatter-adds (``ddpg.py:142-185``); the JAX baseline is the einsum
+formulation in ``core/distribution.py`` (one [B, A, A] intermediate for XLA
+to schedule). Semantics are identical to ``categorical_projection``:
+
+    tz   = clip(r + disc * z, v_min, v_max)
+    b    = (tz - v_min) / delta
+    out_j = sum_i p_i * clip(1 - |b_i - j|, 0, 1)
+
+Batch is tiled over a 1-D grid; atoms stay whole per tile (A = 51 pads to
+one lane tile). Runs under ``interpret=True`` on CPU for tests.
+
+Measured on a v5e chip (B=256/4096, A=51): bitwise-identical to the einsum
+path, but ~1.2-1.7x SLOWER — at this op size XLA's fused einsum already
+keeps everything on-chip and the pallas_call dispatch dominates. The
+einsum formulation therefore stays the default in the learner; this kernel
+is kept as the measured alternative and the template for future fusions
+(e.g. folding the projection into the loss reduction).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+from jax.experimental import pallas as pl
+
+from d4pg_tpu.core.distribution import CategoricalSupport
+
+_TILE_B = 64
+
+
+def _projection_kernel(p_ref, r_ref, d_ref, out_ref, *, v_min, v_max, n_atoms):
+    delta = (v_max - v_min) / (n_atoms - 1)
+    p = p_ref[:]  # [TB, A]
+    r = r_ref[:]  # [TB, 1]
+    d = d_ref[:]  # [TB, 1]
+    # TPU iota is integer-only; cast after.
+    atoms = v_min + delta * jax.lax.broadcasted_iota(
+        jnp.int32, (1, n_atoms), 1
+    ).astype(jnp.float32)  # [1, A]
+    tz = jnp.clip(r + d * atoms, v_min, v_max)  # [TB, A]
+    b = (tz - v_min) / delta  # [TB, A] fractional source positions
+    j = jax.lax.broadcasted_iota(jnp.int32, (1, 1, n_atoms), 2).astype(
+        jnp.float32
+    )  # [1,1,A]
+    w = jnp.clip(1.0 - jnp.abs(b[:, :, None] - j), 0.0, 1.0)  # [TB, A, A]
+    out_ref[:] = jnp.sum(p[:, :, None] * w, axis=1)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4))
+def projection_pallas(
+    support: CategoricalSupport,
+    target_probs: Array,
+    rewards: Array,
+    discounts: Array,
+    interpret: bool = False,
+) -> Array:
+    """Drop-in Pallas variant of ``core.distribution.categorical_projection``.
+
+    target_probs: [B, A]; rewards/discounts: [B]. B is padded up to the
+    batch tile internally; [B, A] comes back exact.
+    """
+    n = target_probs.shape[0]
+    a = support.n_atoms
+    pad = (-n) % _TILE_B
+    p = jnp.pad(target_probs.astype(jnp.float32), ((0, pad), (0, 0)))
+    r = jnp.pad(rewards.astype(jnp.float32), (0, pad))[:, None]
+    d = jnp.pad(discounts.astype(jnp.float32), (0, pad))[:, None]
+    total = n + pad
+
+    kernel = functools.partial(
+        _projection_kernel,
+        v_min=float(support.v_min),
+        v_max=float(support.v_max),
+        n_atoms=a,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(total // _TILE_B,),
+        in_specs=[
+            pl.BlockSpec((_TILE_B, a), lambda i: (i, 0)),
+            pl.BlockSpec((_TILE_B, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_TILE_B, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((_TILE_B, a), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((total, a), jnp.float32),
+        interpret=interpret,
+    )(p, r, d)
+    return out[:n]

@@ -1,0 +1,126 @@
+"""ctypes binding for the C++ PER trees, with transparent numpy fallback.
+
+``load_native()`` returns the shared library handle or None; build it with
+``make -C native`` (g++ only, no third-party deps — pybind11 is not
+available on this image, hence the plain C ABI + ctypes). The
+``NativePerTrees`` class exposes the same operations as the numpy
+``SumTree``/``MinTree`` pair (``segment_tree.py``) behind one object, since
+PER always writes identical priorities to both trees.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "_native", "libper_trees.so")
+_lib = None
+_loaded = False
+
+
+def build_native(quiet: bool = True) -> bool:
+    """Best-effort `make -C native`; returns True if the .so exists after."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    native_dir = os.path.join(repo_root, "native")
+    if not os.path.isdir(native_dir):
+        return os.path.exists(_LIB_PATH)
+    try:
+        subprocess.run(
+            ["make", "-C", native_dir],
+            check=True,
+            capture_output=quiet,
+        )
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return False
+    return os.path.exists(_LIB_PATH)
+
+
+def load_native(autobuild: bool = True):
+    """Load (building if needed) the native library; None on failure."""
+    global _lib, _loaded
+    if _loaded:
+        return _lib
+    _loaded = True
+    if not os.path.exists(_LIB_PATH) and autobuild:
+        build_native()
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.pt_new.restype = ctypes.c_void_p
+    lib.pt_new.argtypes = [ctypes.c_int64]
+    lib.pt_free.argtypes = [ctypes.c_void_p]
+    lib.pt_capacity.restype = ctypes.c_int64
+    lib.pt_capacity.argtypes = [ctypes.c_void_p]
+    lib.pt_set.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+    ]
+    lib.pt_total.restype = ctypes.c_double
+    lib.pt_total.argtypes = [ctypes.c_void_p]
+    lib.pt_min.restype = ctypes.c_double
+    lib.pt_min.argtypes = [ctypes.c_void_p]
+    lib.pt_get.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+    ]
+    lib.pt_find_prefix.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+    ]
+    _lib = lib
+    return _lib
+
+
+def _i64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _f64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+class NativePerTrees:
+    """Sum+min segment trees backed by the C++ extension."""
+
+    def __init__(self, capacity: int):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native per_trees library unavailable")
+        self._lib = lib
+        self._h = ctypes.c_void_p(lib.pt_new(int(capacity)))
+        self.capacity = int(lib.pt_capacity(self._h))
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        h = getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.pt_free(h)
+
+    def set(self, idx: np.ndarray, values: np.ndarray) -> None:
+        idx = np.ascontiguousarray(idx, np.int64)
+        values = np.ascontiguousarray(values, np.float64)
+        self._lib.pt_set(self._h, _i64(idx), _f64(values), len(idx))
+
+    def sum(self) -> float:
+        return float(self._lib.pt_total(self._h))
+
+    def min(self) -> float:
+        return float(self._lib.pt_min(self._h))
+
+    def get(self, idx: np.ndarray) -> np.ndarray:
+        idx = np.ascontiguousarray(idx, np.int64)
+        out = np.empty(len(idx), np.float64)
+        self._lib.pt_get(self._h, _i64(idx), _f64(out), len(idx))
+        return out
+
+    def find_prefixsum(self, prefix: np.ndarray) -> np.ndarray:
+        prefix = np.ascontiguousarray(prefix, np.float64)
+        out = np.empty(len(prefix), np.int64)
+        self._lib.pt_find_prefix(self._h, _f64(prefix), _i64(out), len(prefix))
+        return out

@@ -10,11 +10,12 @@ Parity: the reference's ``PrioritizedReplayBuffer``
   - ``update_priorities`` writes ``priority ** alpha`` into both trees and
     tracks the running max (``:315-335``).
 
-Differences: all operations are batched numpy (or the C++ native sampler);
-sampling segments the total mass into B strata (one uniform draw per
-stratum), which is the standard variance-reduction refinement of the
-reference's B independent uniform draws (``:263-264``) — set
-``stratified=False`` for the reference's exact scheme.
+Differences: all operations are batched numpy (or the C++ native sampler,
+``backend='native'`` / ``native/per_trees.cpp``); sampling segments the
+total mass into B strata (one uniform draw per stratum), which is the
+standard variance-reduction refinement of the reference's B independent
+uniform draws (``:263-264``) — set ``stratified=False`` for the
+reference's exact scheme.
 """
 
 from __future__ import annotations
@@ -23,6 +24,46 @@ import numpy as np
 
 from d4pg_tpu.replay.segment_tree import MinTree, SumTree
 from d4pg_tpu.replay.uniform import ReplayBuffer, TransitionBatch
+
+
+class _NumpyPerTrees:
+    """Sum+min tree pair behind the combined interface the buffer uses
+    (the native backend implements the same one in C++)."""
+
+    def __init__(self, capacity: int):
+        self._sum_tree = SumTree(capacity)
+        self._min_tree = MinTree(capacity)
+        self.capacity = self._sum_tree.capacity
+
+    def set(self, idx: np.ndarray, values: np.ndarray) -> None:
+        self._sum_tree.set(idx, values)
+        self._min_tree.set(idx, values)
+
+    def sum(self) -> float:
+        return self._sum_tree.sum()
+
+    def min(self) -> float:
+        return self._min_tree.min()
+
+    def get(self, idx: np.ndarray) -> np.ndarray:
+        return self._sum_tree.get(idx)
+
+    def find_prefixsum(self, prefix: np.ndarray) -> np.ndarray:
+        return self._sum_tree.find_prefixsum(prefix)
+
+
+def _make_trees(capacity: int, backend: str):
+    if backend not in ("auto", "numpy", "native"):
+        raise ValueError(f"unknown PER backend {backend!r}")
+    if backend in ("auto", "native"):
+        try:
+            from d4pg_tpu.replay.native import NativePerTrees
+
+            return NativePerTrees(capacity)
+        except (RuntimeError, OSError):
+            if backend == "native":
+                raise
+    return _NumpyPerTrees(capacity)
 
 
 class PrioritizedReplayBuffer(ReplayBuffer):
@@ -34,32 +75,31 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         alpha: float = 0.6,
         seed: int = 0,
         stratified: bool = True,
+        backend: str = "auto",
     ):
         super().__init__(capacity, obs_dim, act_dim, seed=seed)
         assert alpha >= 0
         self.alpha = float(alpha)
         self.stratified = bool(stratified)
-        self._sum = SumTree(self.capacity)
-        self._min = MinTree(self.capacity)
+        self._trees = _make_trees(self.capacity, backend)
         self.max_priority = 1.0
 
     def add(self, batch: TransitionBatch) -> np.ndarray:
         idx = super().add(batch)
         p = self.max_priority**self.alpha
-        self._sum.set(idx, np.full(len(idx), p))
-        self._min.set(idx, np.full(len(idx), p))
+        self._trees.set(idx, np.full(len(idx), p))
         return idx
 
     def sample_idx(self, batch_size: int) -> np.ndarray:
         if self.size == 0:
             raise ValueError("cannot sample from an empty buffer")
-        total = self._sum.sum()
+        total = self._trees.sum()
         if self.stratified:
             bounds = np.linspace(0.0, total, batch_size + 1)
             mass = self._rng.uniform(bounds[:-1], bounds[1:])
         else:
             mass = self._rng.uniform(0.0, total, size=batch_size)
-        idx = self._sum.find_prefixsum(mass)
+        idx = self._trees.find_prefixsum(mass)
         # guard: prefix just at/over the total can land on an unwritten leaf
         return np.minimum(idx, max(self.size - 1, 0))
 
@@ -67,10 +107,10 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         """(p_i * N)^-beta / max_weight, max via the min tree
         (``prioritized_replay_memory.py:299-311``)."""
         assert beta > 0
-        total = self._sum.sum()
-        p_min = self._min.min() / total
+        total = self._trees.sum()
+        p_min = self._trees.min() / total
         max_weight = (p_min * self.size) ** (-beta)
-        p = self._sum.get(idx) / total
+        p = self._trees.get(idx) / total
         return ((p * self.size) ** (-beta) / max_weight).astype(np.float32)
 
     def sample(
@@ -83,7 +123,5 @@ class PrioritizedReplayBuffer(ReplayBuffer):
     def update_priorities(self, idx: np.ndarray, priorities: np.ndarray) -> None:
         priorities = np.asarray(priorities, np.float64)
         assert (priorities > 0).all(), "priorities must be positive"
-        p = priorities**self.alpha
-        self._sum.set(idx, p)
-        self._min.set(idx, p)
+        self._trees.set(idx, priorities**self.alpha)
         self.max_priority = max(self.max_priority, float(priorities.max()))

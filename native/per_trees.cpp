@@ -1,0 +1,106 @@
+// Native PER segment trees: batched sum/min tree updates and inverse-CDF
+// sampling for prioritized replay.
+//
+// The reference's Python sampler walks the sum tree one transition at a time
+// (prioritized_replay_memory.py:126-149), an O(B log N) pointer chase in the
+// interpreter that SURVEY.md flags as the throughput hazard feeding a TPU
+// learner. The numpy backend (d4pg_tpu/replay/segment_tree.py) vectorizes
+// the walk; this C++ backend removes the remaining numpy dispatch overhead
+// for large capacities and serves as the framework's host-side native
+// component (SURVEY.md §2 "Native components").
+//
+// Layout: one object holds BOTH trees (PER always writes the same priorities
+// to both): flat arrays of 2*cap nodes, node 1 = root, leaf i at cap + i.
+// C ABI for ctypes; no exceptions cross the boundary.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <limits>
+#include <vector>
+
+namespace {
+
+struct PerTrees {
+  int64_t cap;        // power-of-two leaf count
+  int levels;
+  std::vector<double> sum;  // 2*cap
+  std::vector<double> mn;   // 2*cap
+};
+
+int64_t next_pow2(int64_t n) {
+  int64_t p = 1;
+  while (p < n) p <<= 1;
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pt_new(int64_t capacity) {
+  auto* t = new PerTrees();
+  t->cap = next_pow2(capacity);
+  t->levels = static_cast<int>(std::log2(static_cast<double>(t->cap)) + 0.5);
+  t->sum.assign(2 * t->cap, 0.0);
+  t->mn.assign(2 * t->cap, std::numeric_limits<double>::infinity());
+  return t;
+}
+
+void pt_free(void* h) { delete static_cast<PerTrees*>(h); }
+
+int64_t pt_capacity(void* h) { return static_cast<PerTrees*>(h)->cap; }
+
+// Batched leaf write + ancestor repair on the touched path only.
+void pt_set(void* h, const int64_t* idx, const double* values, int64_t n) {
+  auto* t = static_cast<PerTrees*>(h);
+  for (int64_t k = 0; k < n; ++k) {
+    int64_t node = idx[k] + t->cap;
+    t->sum[node] = values[k];
+    t->mn[node] = values[k];
+  }
+  for (int64_t k = 0; k < n; ++k) {
+    int64_t node = (idx[k] + t->cap) >> 1;
+    while (node >= 1) {
+      int64_t l = node << 1;
+      double s = t->sum[l] + t->sum[l | 1];
+      double m = std::min(t->mn[l], t->mn[l | 1]);
+      if (t->sum[node] == s && t->mn[node] == m) break;  // path already fixed
+      t->sum[node] = s;
+      t->mn[node] = m;
+      node >>= 1;
+    }
+  }
+}
+
+double pt_total(void* h) { return static_cast<PerTrees*>(h)->sum[1]; }
+
+double pt_min(void* h) { return static_cast<PerTrees*>(h)->mn[1]; }
+
+void pt_get(void* h, const int64_t* idx, double* out, int64_t n) {
+  auto* t = static_cast<PerTrees*>(h);
+  for (int64_t k = 0; k < n; ++k) out[k] = t->sum[idx[k] + t->cap];
+}
+
+// Batched inverse-CDF: for each prefix mass, the smallest leaf i with
+// cumulative sum(leaves[:i+1]) > mass.
+void pt_find_prefix(void* h, const double* mass, int64_t* out, int64_t n) {
+  auto* t = static_cast<PerTrees*>(h);
+  for (int64_t k = 0; k < n; ++k) {
+    double p = mass[k];
+    int64_t node = 1;
+    for (int lv = 0; lv < t->levels; ++lv) {
+      int64_t l = node << 1;
+      double ls = t->sum[l];
+      if (p >= ls) {
+        p -= ls;
+        node = l | 1;
+      } else {
+        node = l;
+      }
+    }
+    out[k] = node - t->cap;
+  }
+}
+
+}  // extern "C"

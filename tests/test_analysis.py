@@ -1,0 +1,67 @@
+"""Analysis-layer tests: EWMA math, run logger persistence, plot CLI."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.analysis import RunLogger, ewma, load_returns_csv, plot_runs
+
+
+def test_ewma_constant_series():
+    x = np.full(10, 3.0)
+    np.testing.assert_allclose(ewma(x), x, rtol=1e-12)
+
+
+def test_ewma_matches_reference_formulation():
+    """Bias-corrected EWMA equals the reference's scaling-matrix form
+    (plots/plots.py:8-21): y_t = sum_k a^(t-k) (1-a) x_k / (1 - a^(t+1))."""
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(50)
+    a = 0.95
+    t = np.arange(50)
+    ref = np.array([
+        np.sum(a ** (ti - t[: ti + 1]) * (1 - a) * x[: ti + 1]) / (1 - a ** (ti + 1))
+        for ti in t
+    ])
+    np.testing.assert_allclose(ewma(x, a), ref, rtol=1e-10)
+
+
+def test_ewma_empty():
+    assert ewma(np.array([])).shape == (0,)
+
+
+def test_run_logger_roundtrip(tmp_path):
+    path = str(tmp_path / "log.jsonl")
+    log = RunLogger(path, "runA")
+    log.log("return", 1, -10.0)
+    log.log("return", 2, -5.0)
+    log.log("loss", 1, 3.0)
+    log.close()
+    log2 = RunLogger(path, "runB")  # append-only: same file, second run
+    log2.log("return", 1, -20.0)
+    log2.close()
+    runs = RunLogger.load(path)
+    assert runs["runA"]["return"] == [(1, -10.0), (2, -5.0)]
+    assert runs["runA"]["loss"] == [(1, 3.0)]
+    assert runs["runB"]["return"] == [(1, -20.0)]
+
+
+def test_load_returns_csv_skips_malformed(tmp_path):
+    p = tmp_path / "returns.csv"
+    p.write_text("step,avg\n1,-10.5\nbad,row\n2,-9.0\n")
+    steps, rets = load_returns_csv(str(p))
+    np.testing.assert_array_equal(steps, [1, 2])
+    np.testing.assert_array_equal(rets, [-10.5, -9.0])
+
+
+def test_plot_runs_writes_png(tmp_path):
+    out = str(tmp_path / "out.png")
+    steps = np.arange(20)
+    path = plot_runs(
+        {"a": (steps, -100 + steps.astype(float)),
+         "b": (steps, -120 + 2 * steps.astype(float))},
+        out_path=out,
+    )
+    assert os.path.exists(path) and os.path.getsize(path) > 1000

@@ -124,6 +124,26 @@ def test_actor_run_resumes_across_cycles():
     assert collect([10, 10]) == collect([20])
 
 
+def test_actor_ou_noise_path():
+    """noise='ou' runs the temporally-correlated process (the reference's
+    dead --ou_* flags, wired for real) and resets it at episode boundaries."""
+    config = D4PGConfig(obs_dim=4, act_dim=2, v_min=-5, v_max=0, n_atoms=11,
+                        hidden=(16, 16))
+    svc = ReplayService(ReplayBuffer(10_000, 4, 2))
+    ws = WeightStore()
+    ws.publish(init_state(config, jax.random.key(0)).actor_params, step=0)
+    pool = EnvPool([lambda: PointMassEnv(horizon=10, seed=0)])
+    actor = ActorWorker("ou0", config, ActorConfig(noise="ou", ou_sigma=0.3),
+                        pool, svc, ws, seed=3)
+    actor.run(max_steps=10)  # crosses one episode boundary (horizon 10)
+    svc.flush()
+    assert len(svc) > 0
+    assert actor._ou is not None
+    # episode ended on the last tick -> OU state was zeroed
+    np.testing.assert_allclose(np.asarray(actor._ou.x), 0.0, atol=1e-7)
+    svc.close()
+
+
 def test_actor_without_weights_uses_random_policy():
     config = D4PGConfig(obs_dim=4, act_dim=2, v_min=-5, v_max=0, n_atoms=11,
                         hidden=(16, 16))

@@ -1,0 +1,43 @@
+"""Pallas kernel tests (interpret mode on the CPU backend).
+
+The einsum projection (core/distribution.py, itself oracle-tested against
+the reference's per-atom loop in test_projection.py) is the oracle here.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from d4pg_tpu.core.distribution import CategoricalSupport, categorical_projection
+from d4pg_tpu.ops.projection import projection_pallas
+
+
+def _rand_dist(rng, b, a):
+    p = rng.random((b, a))
+    return (p / p.sum(-1, keepdims=True)).astype(np.float32)
+
+
+@pytest.mark.parametrize("batch", [1, 64, 100])
+def test_pallas_projection_matches_einsum(rng, batch):
+    sup = CategoricalSupport(-10.0, 0.0, 51)
+    p = jnp.asarray(_rand_dist(rng, batch, 51))
+    r = jnp.asarray(rng.uniform(-12, 2, batch), jnp.float32)  # incl. out-of-range
+    done = rng.random(batch) < 0.3
+    d = jnp.asarray((0.99**3) * ~done, jnp.float32)
+    ref = categorical_projection(sup, p, r, d)
+    out = projection_pallas(sup, p, r, d, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out).sum(-1), 1.0, atol=1e-5)
+
+
+def test_pallas_projection_terminal_delta(rng):
+    """Terminal transitions (discount 0) collapse to a delta at clip(r)."""
+    sup = CategoricalSupport(0.0, 10.0, 11)
+    p = jnp.asarray(_rand_dist(rng, 8, 11))
+    r = jnp.asarray(np.full(8, 5.0), jnp.float32)
+    d = jnp.zeros(8, jnp.float32)
+    out = np.asarray(projection_pallas(sup, p, r, d, True))
+    want = np.zeros((8, 11), np.float32)
+    want[:, 5] = 1.0  # atom exactly at 5.0
+    np.testing.assert_allclose(out, want, atol=1e-6)

@@ -155,7 +155,7 @@ def test_per_new_items_get_max_priority():
     buf.update_priorities(i1, np.array([10.0, 1.0]))
     i2 = buf.add(make_batch(1, 1, 1, seed=9))
     # new item inherits max_priority (=10)
-    assert buf._sum.get(i2)[0] == pytest.approx(10.0)
+    assert buf._trees.get(i2)[0] == pytest.approx(10.0)
 
 
 def test_per_sample_roundtrip():
