@@ -1,0 +1,101 @@
+"""Remote actor runner: ``python -m d4pg_tpu.actor_main --learner_host ...``
+
+Runs acting on a separate host (TPU-VM actor fleet), streaming transitions
+to the learner's ``TransitionReceiver`` and pulling weights from its
+``WeightServer`` — the cross-host replacement for the reference's fork'd
+same-host workers sharing memory (``main.py:393-405``). Actors are
+stateless: kill one and start another; replay and weights live with the
+learner.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from d4pg_tpu.config import ExperimentConfig
+from d4pg_tpu.distributed.actor import ActorConfig, ActorWorker
+from d4pg_tpu.distributed.transport import TransitionSender
+from d4pg_tpu.distributed.weight_server import WeightClient
+from d4pg_tpu.envs import EnvPool
+from d4pg_tpu.replay.uniform import TransitionBatch
+from d4pg_tpu.train import infer_dims, make_env_fn
+
+
+class RemoteReplayClient:
+    """ReplayService-shaped adapter over the transition socket."""
+
+    def __init__(self, sender: TransitionSender):
+        self._sender = sender
+
+    def add(self, batch: TransitionBatch, actor_id: str = "remote",
+            block: bool = True, timeout: float | None = None) -> bool:
+        del actor_id, block, timeout  # TCP provides ordering + backpressure
+        self._sender.send(batch)
+        return True
+
+
+def run_actor(
+    cfg: ExperimentConfig,
+    learner_host: str,
+    transitions_port: int,
+    weights_port: int,
+    actor_id: str = "remote-0",
+    max_ticks: int | None = None,
+) -> int:
+    cfg = cfg.resolve()
+    obs_dim, act_dim, obs_dtype = infer_dims(cfg)
+    config = cfg.learner_config(obs_dim, act_dim)
+    sender = TransitionSender(learner_host, transitions_port, actor_id=actor_id)
+    weights = WeightClient(learner_host, weights_port)
+    pool = EnvPool(
+        [make_env_fn(cfg, seed=cfg.seed + i) for i in range(cfg.num_envs)],
+        seed=cfg.seed,
+    )
+    actor = ActorWorker(
+        actor_id, config,
+        ActorConfig(
+            epsilon_0=cfg.epsilon_0, min_epsilon=cfg.min_epsilon,
+            epsilon_horizon=cfg.epsilon_horizon, n_step=cfg.n_steps,
+            gamma=cfg.gamma, reward_scale=cfg.reward_scale, noise=cfg.noise,
+            ou_theta=cfg.ou_theta, ou_sigma=cfg.ou_sigma, ou_mu=cfg.ou_mu,
+        ),
+        pool, RemoteReplayClient(sender), weights, seed=cfg.seed,
+        obs_dtype=obs_dtype,
+    )
+    try:
+        if max_ticks is None:
+            while True:
+                actor.run(1000)
+        else:
+            actor.run(max_ticks)
+    except (KeyboardInterrupt, ConnectionError, BrokenPipeError, OSError) as e:
+        print(f"actor {actor_id} stopping: {type(e).__name__}: {e}")
+    finally:
+        sender.close()
+        weights.close()
+        pool.close()
+    return actor.env_steps
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="d4pg_tpu.actor_main")
+    p.add_argument("--learner_host", required=True)
+    p.add_argument("--transitions_port", type=int, required=True)
+    p.add_argument("--weights_port", type=int, required=True)
+    p.add_argument("--actor_id", default="remote-0")
+    p.add_argument("--env", default="Pendulum-v1")
+    p.add_argument("--num_envs", type=int, default=4)
+    p.add_argument("--n_steps", type=int, default=3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--noise", choices=("gaussian", "ou"), default="gaussian")
+    p.add_argument("--max_ticks", type=int, default=None)
+    ns = p.parse_args(argv)
+    cfg = ExperimentConfig(env=ns.env, num_envs=ns.num_envs, n_steps=ns.n_steps,
+                           seed=ns.seed, noise=ns.noise)
+    steps = run_actor(cfg, ns.learner_host, ns.transitions_port,
+                      ns.weights_port, ns.actor_id, ns.max_ticks)
+    print(f"collected {steps} env steps")
+
+
+if __name__ == "__main__":
+    main()

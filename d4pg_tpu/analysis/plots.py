@@ -73,6 +73,9 @@ def main(argv=None) -> None:
             runs[os.path.basename(run_dir.rstrip("/"))] = load_returns_csv(csv_path)
         else:
             print(f"skip {run_dir}: no returns.csv")
+    if not runs:
+        print("error: no run dir contained a returns.csv")
+        raise SystemExit(1)
     out = plot_runs(runs, out_path="returns.png")
     print(f"wrote {out}")
 

@@ -14,6 +14,8 @@ from __future__ import annotations
 import argparse
 import dataclasses
 
+import numpy as np
+
 from d4pg_tpu.envs.presets import get_preset
 from d4pg_tpu.learner.state import D4PGConfig
 
@@ -65,6 +67,11 @@ class ExperimentConfig:
     # distributed
     n_workers: int = 1  # --n_workers (actor count)
     data_parallel: int = 1  # learner mesh data axis (1 = single device)
+    async_actors: bool = False  # decoupled D4PG-paper actor/learner loop
+    serve: bool = False  # accept remote actors (actor_main.py) over TCP
+    serve_transitions_port: int = 0  # 0 = ephemeral
+    serve_weights_port: int = 0
+    profile_dir: str = ""  # capture an XLA trace of the first cycle
     # io
     log_dir: str = "runs"  # --log_dir
     seed: int = 0
@@ -94,10 +101,15 @@ class ExperimentConfig:
             updates["reward_scale"] = preset.reward_scale
         return dataclasses.replace(self, **updates) if updates else self
 
-    def learner_config(self, obs_dim: int, act_dim: int) -> D4PGConfig:
+    def learner_config(self, obs_dim: int | tuple, act_dim: int) -> D4PGConfig:
+        """``obs_dim`` is an int (vector obs) or an [H, W, C] tuple, which
+        selects the conv-encoder pixel path (BASELINE.md config #4)."""
         resolved = self.resolve()
+        pixels = not np.isscalar(obs_dim)
         return D4PGConfig(
-            obs_dim=obs_dim,
+            obs_dim=int(np.prod(obs_dim)) if pixels else obs_dim,
+            pixels=pixels,
+            obs_shape=tuple(obs_dim) if pixels else (),
             act_dim=act_dim,
             v_min=float(resolved.v_min),
             v_max=float(resolved.v_max),
@@ -164,6 +176,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--eval_trials", type=int, default=d.eval_trials)
     p.add_argument("--n_workers", type=int, default=d.n_workers)
     p.add_argument("--data_parallel", type=int, default=d.data_parallel)
+    _add_bool_flag(p, "async_actors", d.async_actors,
+                   "decoupled actor/learner loop")
+    _add_bool_flag(p, "serve", d.serve, "accept remote actors over TCP")
+    p.add_argument("--serve_transitions_port", type=int,
+                   default=d.serve_transitions_port)
+    p.add_argument("--serve_weights_port", type=int, default=d.serve_weights_port)
+    p.add_argument("--profile_dir", default=d.profile_dir)
     p.add_argument("--log_dir", default=d.log_dir)
     p.add_argument("--seed", type=int, default=d.seed)
     p.add_argument("--reward_scale", type=float, default=d.reward_scale)
@@ -178,4 +197,6 @@ def parse_args(argv=None) -> ExperimentConfig:
     ns["prioritized_replay"] = bool(ns.pop("p_replay"))
     ns["resume"] = bool(ns["resume"])
     ns["debug"] = bool(ns["debug"])
+    ns["async_actors"] = bool(ns["async_actors"])
+    ns["serve"] = bool(ns["serve"])
     return ExperimentConfig(**ns)

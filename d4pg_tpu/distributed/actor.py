@@ -151,12 +151,13 @@ class ActorWorker(_BaseActor):
         service: ReplayService,
         weights: WeightStore,
         seed: int = 0,
+        obs_dtype=None,
     ):
         super().__init__(actor_id, config, actor_cfg, service, weights, seed)
         self.pool = pool
         self._folder = NStepFolder(
             actor_cfg.n_step, actor_cfg.gamma, pool.num_envs,
-            config.obs_dim, config.act_dim,
+            config.obs_spec, config.act_dim, obs_dtype=obs_dtype,
         )
         self._obs: np.ndarray | None = None
 

@@ -1,0 +1,149 @@
+"""Weight distribution over the network (learner -> remote actors).
+
+Completes the DCN plane: ``transport.py`` streams transitions actor ->
+learner; this module broadcasts versioned actor params learner -> actors.
+Same length-prefixed frame format, request/response over TCP:
+
+  client sends  [u32 magic][i64 have_version]
+  server replies[u32 magic][u32 len][payload]   (len==0: not newer)
+
+payload = npz of the flattened param pytree + version + step. The treedef
+is reconstructed client-side from sorted flat keys, so only arrays cross
+the wire. Replaces the reference's shared-memory ``state_dict`` pulls
+(``ddpg.py:118-120``, ``main.py:113-114``) for the cross-host case.
+"""
+
+from __future__ import annotations
+
+import io
+import socket
+import struct
+import threading
+
+import numpy as np
+
+from d4pg_tpu.distributed.weights import WeightStore
+
+_MAGIC = 0xD4F7
+_REQ = struct.Struct("!Iq")
+_RESP = struct.Struct("!II")
+
+
+def _flatten(params) -> dict[str, np.ndarray]:
+    """Flatten a nested dict pytree to {'a/b/c': array} (flax's own
+    param-dict flattening, so key semantics match Flax exactly)."""
+    from flax.traverse_util import flatten_dict
+
+    return {k: np.asarray(v) for k, v in flatten_dict(params, sep="/").items()}
+
+
+def _unflatten(flat: dict[str, np.ndarray]):
+    from flax.traverse_util import unflatten_dict
+
+    return unflatten_dict(dict(flat), sep="/")
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    chunks = []
+    while n:
+        chunk = sock.recv(n)
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+class WeightServer:
+    """Serves a WeightStore's latest params to remote pullers."""
+
+    def __init__(self, store: WeightStore, host: str = "0.0.0.0", port: int = 0):
+        self._store = store
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, port))
+        self._server.listen()
+        self.port = self._server.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept, daemon=True)
+        self._thread.start()
+
+    def _accept(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._server.settimeout(0.2)
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._stop.is_set():
+                req = _recv_exact(conn, _REQ.size)
+                if req is None:
+                    return
+                magic, have = _REQ.unpack(req)
+                if magic != _MAGIC:
+                    return
+                got = self._store.get_if_newer(have)
+                if got is None:
+                    conn.sendall(_RESP.pack(_MAGIC, 0))
+                    continue
+                version, params = got
+                buf = io.BytesIO()
+                flat = _flatten(params)
+                np.savez(
+                    buf,
+                    __version__=np.int64(version),
+                    __step__=np.int64(self._store.step),
+                    **flat,
+                )
+                payload = buf.getvalue()
+                conn.sendall(_RESP.pack(_MAGIC, len(payload)) + payload)
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+
+class WeightClient:
+    """Actor-side puller mirroring the WeightStore reader interface, so a
+    remote actor constructs its WeightStore-shaped view from the wire."""
+
+    def __init__(self, host: str, port: int, connect_timeout: float = 10.0):
+        self._sock = socket.create_connection((host, port), timeout=connect_timeout)
+        self._sock.settimeout(None)
+        self._lock = threading.Lock()
+        self.step = 0
+
+    def get_if_newer(self, have_version: int):
+        with self._lock:
+            self._sock.sendall(_REQ.pack(_MAGIC, int(have_version)))
+            head = _recv_exact(self._sock, _RESP.size)
+            if head is None:
+                raise ConnectionError("weight server closed the connection")
+            magic, length = _RESP.unpack(head)
+            if magic != _MAGIC:
+                raise ConnectionError("corrupt weight stream")
+            if length == 0:
+                return None
+            payload = _recv_exact(self._sock, length)
+            if payload is None:
+                raise ConnectionError("truncated weight payload")
+        with np.load(io.BytesIO(payload)) as z:
+            flat = {k: z[k] for k in z.files if not k.startswith("__")}
+            version = int(z["__version__"])
+            self.step = int(z["__step__"])
+        return version, _unflatten(flat)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

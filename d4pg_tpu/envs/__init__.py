@@ -18,7 +18,7 @@ from d4pg_tpu.envs.wrappers import (
 from d4pg_tpu.envs.her import her_relabel
 from d4pg_tpu.envs.vector import EnvPool
 from d4pg_tpu.envs.presets import EnvPreset, PRESETS, get_preset
-from d4pg_tpu.envs.fake import FakeGoalEnv, PointMassEnv
+from d4pg_tpu.envs.fake import FakeGoalEnv, PixelPointEnv, PointMassEnv
 
 __all__ = [
     "GoalObs",
@@ -31,5 +31,6 @@ __all__ = [
     "PRESETS",
     "get_preset",
     "FakeGoalEnv",
+    "PixelPointEnv",
     "PointMassEnv",
 ]

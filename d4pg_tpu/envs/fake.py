@@ -58,6 +58,47 @@ class PointMassEnv:
         pass
 
 
+class PixelPointEnv:
+    """Pixel-observation point mass: the agent is a bright blob on an
+    [H, W, 3] uint8 frame; action = velocity; reward = -|pos - center|.
+    Stand-in for the DM-Control-from-pixels config (BASELINE.md #4) so the
+    conv-encoder path tests without dm_control/MuJoCo."""
+
+    def __init__(self, size: int = 16, horizon: int = 50, seed: int = 0):
+        self.size = int(size)
+        self.horizon = horizon
+        self.action_space = _Box(-1.0, 1.0, (2,))
+        self.observation_space = _Box(0, 255, (self.size, self.size, 3))
+        self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._pos = np.zeros(2, np.float32)  # in [0, 1]^2
+
+    def _obs(self):
+        frame = np.zeros((self.size, self.size, 3), np.uint8)
+        i = int(np.clip(self._pos[0] * (self.size - 1), 0, self.size - 1))
+        j = int(np.clip(self._pos[1] * (self.size - 1), 0, self.size - 1))
+        frame[i, j] = 255
+        return frame
+
+    def reset(self, seed=None, **kw):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._pos = self._rng.uniform(0, 1, 2).astype(np.float32)
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        action = np.clip(np.asarray(action, np.float32), -1.0, 1.0)
+        self._pos = np.clip(self._pos + 0.1 * action, 0.0, 1.0)
+        self._t += 1
+        reward = float(-np.linalg.norm(self._pos - 0.5))
+        truncated = self._t >= self.horizon
+        return self._obs(), reward, False, truncated, {}
+
+    def close(self):
+        pass
+
+
 class FakeGoalEnv:
     """Goal-conditioned point reach with sparse -1/0 reward and dict obs."""
 

@@ -45,11 +45,20 @@ class EnvPool:
         self.episode_returns: list[float] = []
         self.episode_lengths: list[int] = []
 
+    @staticmethod
+    def _stack(obs_list: list) -> np.ndarray:
+        """Stack observations, downcasting floats to float32 but keeping
+        integer dtypes (uint8 pixel frames) untouched."""
+        out = np.stack(obs_list)
+        if np.issubdtype(out.dtype, np.floating) and out.dtype != np.float32:
+            out = out.astype(np.float32)
+        return out
+
     def reset(self) -> np.ndarray:
         obs = [e.reset(seed=self._seed + i)[0] for i, e in enumerate(self.envs)]
         self._ep_return[:] = 0.0
         self._ep_length[:] = 0
-        return np.stack(obs).astype(np.float32)
+        return self._stack(obs)
 
     def step(self, actions: np.ndarray) -> PoolStep:
         """actions in tanh range (-1,1); rescaled per-env to [low, high]."""
@@ -71,11 +80,11 @@ class EnvPool:
             term_l.append(term)
             trunc_l.append(trunc)
         return PoolStep(
-            obs=np.stack(obs_l).astype(np.float32),
+            obs=self._stack(obs_l),
             reward=np.asarray(rew_l, np.float32),
             terminated=np.asarray(term_l, bool),
             truncated=np.asarray(trunc_l, bool),
-            final_obs=np.stack(final_l).astype(np.float32),
+            final_obs=self._stack(final_l),
         )
 
     def close(self) -> None:

@@ -1,0 +1,55 @@
+"""Profiling: XLA trace capture + step-rate tracking.
+
+SURVEY.md §5: the reference's only timing is wall-clock deltas into a dict
+that is never persisted (``main.py:250, 359``). Here: ``jax.profiler``
+traces on demand (viewable in TensorBoard/Perfetto) and an EWMA'd
+grad-steps/sec meter — the north-star metric (BASELINE.md) — cheap enough
+to leave on.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+@contextlib.contextmanager
+def xla_trace(log_dir: str | None):
+    """Capture an XLA profiler trace into ``log_dir`` (no-op when None)."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+class StepTimer:
+    """EWMA steps/sec over explicitly bracketed update spans.
+
+    ``start()`` ... ``stop(n)`` measures ONLY the bracketed region, so the
+    reported rate is pure update throughput — not diluted by eval/collect/
+    checkpoint time happening between brackets.
+    """
+
+    def __init__(self, alpha: float = 0.9):
+        self._alpha = alpha
+        self._t0: float | None = None
+        self.rate: float | None = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, n_steps: int) -> float | None:
+        if self._t0 is None:
+            return self.rate
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        if dt > 0 and n_steps > 0:
+            inst = n_steps / dt
+            self.rate = (
+                inst if self.rate is None
+                else self._alpha * self.rate + (1 - self._alpha) * inst
+            )
+        return self.rate

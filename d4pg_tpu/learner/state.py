@@ -72,6 +72,11 @@ class D4PGConfig:
     def support(self) -> CategoricalSupport:
         return CategoricalSupport(self.v_min, self.v_max, self.n_atoms)
 
+    @property
+    def obs_spec(self) -> int | tuple:
+        """Replay/folder storage spec: [H, W, C] for pixels, else obs_dim."""
+        return tuple(self.obs_shape) if self.pixels else self.obs_dim
+
     def build_actor(self) -> nn.Module:
         if self.pixels:
             return PixelActor(self.act_dim, hidden=self.hidden)

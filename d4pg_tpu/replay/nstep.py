@@ -28,12 +28,19 @@ from d4pg_tpu.replay.uniform import TransitionBatch
 
 
 class NStepFolder:
-    def __init__(self, n: int, gamma: float, num_envs: int, obs_dim: int, act_dim: int):
+    def __init__(
+        self, n: int, gamma: float, num_envs: int, obs_dim: int | tuple,
+        act_dim: int, obs_dtype=None,
+    ):
         assert n >= 1
         self.n = int(n)
         self.gamma = float(gamma)
         self.num_envs = int(num_envs)
-        self._obs = np.zeros((num_envs, n, obs_dim), np.float32)
+        obs_shape = (obs_dim,) if np.isscalar(obs_dim) else tuple(obs_dim)
+        if obs_dtype is None:
+            obs_dtype = np.float32 if len(obs_shape) == 1 else np.uint8
+        self._obs_shape = obs_shape
+        self._obs = np.zeros((num_envs, n, *obs_shape), obs_dtype)
         self._act = np.zeros((num_envs, n, act_dim), np.float32)
         self._rew = np.zeros((num_envs, n), np.float32)
         self._count = np.zeros(num_envs, np.int64)
@@ -116,10 +123,10 @@ class NStepFolder:
         if not rows:
             z = np.zeros((0,), np.float32)
             return TransitionBatch(
-                obs=np.zeros((0, self._obs.shape[-1]), np.float32),
+                obs=np.zeros((0, *self._obs_shape), self._obs.dtype),
                 action=np.zeros((0, self._act.shape[-1]), np.float32),
                 reward=z,
-                next_obs=np.zeros((0, self._obs.shape[-1]), np.float32),
+                next_obs=np.zeros((0, *self._obs_shape), self._obs.dtype),
                 done=z,
                 discount=z,
             )

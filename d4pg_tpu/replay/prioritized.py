@@ -76,8 +76,10 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         seed: int = 0,
         stratified: bool = True,
         backend: str = "auto",
+        obs_dtype=None,
     ):
-        super().__init__(capacity, obs_dim, act_dim, seed=seed)
+        super().__init__(capacity, obs_dim, act_dim, seed=seed,
+                         obs_dtype=obs_dtype)
         assert alpha >= 0
         self.alpha = float(alpha)
         self.stratified = bool(stratified)

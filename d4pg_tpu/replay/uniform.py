@@ -37,14 +37,29 @@ class TransitionBatch(NamedTuple):
 
 
 class ReplayBuffer:
-    """Fixed-capacity ring buffer over preallocated numpy storage."""
+    """Fixed-capacity ring buffer over preallocated numpy storage.
 
-    def __init__(self, capacity: int, obs_dim: int, act_dim: int, seed: int = 0):
+    ``obs_dim`` is an int for vector observations or a shape tuple for
+    structured ones (e.g. ``(H, W, C)`` pixels, stored uint8 to keep a
+    1M-frame buffer in host RAM; BASELINE.md config #4).
+    """
+
+    def __init__(
+        self,
+        capacity: int,
+        obs_dim: int | tuple,
+        act_dim: int,
+        seed: int = 0,
+        obs_dtype=None,
+    ):
         self.capacity = int(capacity)
-        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        obs_shape = (obs_dim,) if np.isscalar(obs_dim) else tuple(obs_dim)
+        if obs_dtype is None:
+            obs_dtype = np.float32 if len(obs_shape) == 1 else np.uint8
+        self.obs = np.zeros((capacity, *obs_shape), obs_dtype)
         self.action = np.zeros((capacity, act_dim), np.float32)
         self.reward = np.zeros((capacity,), np.float32)
-        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, *obs_shape), obs_dtype)
         self.done = np.zeros((capacity,), np.float32)
         self.discount = np.zeros((capacity,), np.float32)
         self.size = 0

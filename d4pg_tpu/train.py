@@ -17,6 +17,7 @@ with per-cycle eval, TensorBoard logging and checkpointing
 from __future__ import annotations
 
 import os
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -31,8 +32,15 @@ from d4pg_tpu.distributed import (
     WeightStore,
 )
 from d4pg_tpu.distributed.actor import GoalActorWorker
-from d4pg_tpu.envs import EnvPool, FakeGoalEnv, PointMassEnv, get_preset
+from d4pg_tpu.envs import (
+    EnvPool,
+    FakeGoalEnv,
+    PixelPointEnv,
+    PointMassEnv,
+    get_preset,
+)
 from d4pg_tpu.io import CheckpointManager, CsvLogger, MetricsBus, TensorBoardSink
+from d4pg_tpu.io.profiling import StepTimer, xla_trace
 from d4pg_tpu.learner import init_state, make_update
 from d4pg_tpu.parallel import (
     MeshSpec,
@@ -51,24 +59,38 @@ def make_env_fn(cfg: ExperimentConfig, seed: int):
         return lambda: PointMassEnv(horizon=cfg.max_steps, seed=seed)
     if cfg.env == "fake-goal":
         return lambda: FakeGoalEnv(horizon=cfg.max_steps, seed=seed)
+    if cfg.env == "pixel-point":
+        return lambda: PixelPointEnv(horizon=cfg.max_steps, seed=seed)
     import gymnasium as gym
 
     return lambda: gym.make(cfg.env)
 
 
-def infer_dims(cfg: ExperimentConfig) -> tuple[int, int]:
-    """obs/act dims, goal-concatenated for HER envs (``main.py:73-80``)."""
+def infer_dims(cfg: ExperimentConfig) -> tuple[int | tuple, int, np.dtype]:
+    """obs spec, act dim, and obs storage dtype; goal-concatenated for HER
+    envs (``main.py:73-80``), an [H, W, C] shape tuple for pixel envs. The
+    dtype comes from an actual reset observation — rank alone must not
+    decide it (a float-valued 3-D obs stored as uint8 would be silently
+    truncated to garbage)."""
     env = make_env_fn(cfg, seed=0)()
     try:
+        shape = env.observation_space.shape
+        obs_dtype = np.dtype(np.float32)
         if cfg.her:
             obs, _ = env.reset(seed=0)
             obs_dim = obs["observation"].shape[-1] + obs["desired_goal"].shape[-1]
+        elif len(shape) == 3:  # pixels
+            obs_dim = tuple(shape)
+            obs, _ = env.reset(seed=0)
+            obs_dtype = np.asarray(obs).dtype
+            if np.issubdtype(obs_dtype, np.floating):
+                obs_dtype = np.dtype(np.float32)
         else:
-            obs_dim = int(np.prod(env.observation_space.shape))
+            obs_dim = int(np.prod(shape))
         act_dim = int(np.prod(env.action_space.shape))
     finally:
         env.close()
-    return obs_dim, act_dim
+    return obs_dim, act_dim, obs_dtype
 
 
 def train(cfg: ExperimentConfig) -> dict:
@@ -76,7 +98,7 @@ def train(cfg: ExperimentConfig) -> dict:
     run_dir = os.path.join(cfg.log_dir, cfg.run_name())
     os.makedirs(run_dir, exist_ok=True)
 
-    obs_dim, act_dim = infer_dims(cfg)
+    obs_dim, act_dim, obs_dtype = infer_dims(cfg)
     config = cfg.learner_config(obs_dim, act_dim)
 
     # --- learner state + update (single-device or sharded) ----------------
@@ -94,9 +116,11 @@ def train(cfg: ExperimentConfig) -> dict:
     # --- replay + schedule ------------------------------------------------
     if cfg.prioritized_replay:
         buffer = PrioritizedReplayBuffer(cfg.memory_size, obs_dim, act_dim,
-                                         alpha=cfg.per_alpha, seed=cfg.seed)
+                                         alpha=cfg.per_alpha, seed=cfg.seed,
+                                         obs_dtype=obs_dtype)
     else:
-        buffer = ReplayBuffer(cfg.memory_size, obs_dim, act_dim, seed=cfg.seed)
+        buffer = ReplayBuffer(cfg.memory_size, obs_dim, act_dim, seed=cfg.seed,
+                              obs_dtype=obs_dtype)
     beta = LinearSchedule(cfg.per_beta_steps, 1.0, cfg.per_beta0)
     service = ReplayService(buffer)
 
@@ -146,7 +170,7 @@ def train(cfg: ExperimentConfig) -> dict:
                 seed=cfg.seed + w,
             )
             actor = ActorWorker(f"actor-{w}", config, actor_cfg, pool, service,
-                                weights, seed=cfg.seed + w)
+                                weights, seed=cfg.seed + w, obs_dtype=obs_dtype)
         actors.append(actor)
     evaluator = Evaluator(config, make_env_fn(cfg, seed=cfg.seed + 777), weights,
                           max_steps=cfg.max_steps, goal_conditioned=cfg.her)
@@ -162,41 +186,85 @@ def train(cfg: ExperimentConfig) -> dict:
     service.flush()
     print(f"warmup done: {len(service)} transitions")
 
-    # --- the HER-paper loop (main.py:299-368) ----------------------------
+    # --- optional network serving for remote actors (actor_main.py) ------
+    receiver = weight_server = None
+    if cfg.serve:
+        from d4pg_tpu.distributed.transport import TransitionReceiver
+        from d4pg_tpu.distributed.weight_server import WeightServer
+
+        receiver = TransitionReceiver(
+            lambda b, aid: service.add(b, actor_id=aid),
+            port=cfg.serve_transitions_port,
+        )
+        weight_server = WeightServer(weights, port=cfg.serve_weights_port)
+        print(f"serving: transitions :{receiver.port} weights :{weight_server.port}",
+              flush=True)
+
+    # --- the HER-paper loop (main.py:299-368), or the decoupled async
+    # actor-learner architecture of the D4PG paper (--async_actors 1) ------
     def publish():
         p = state.actor_params if mesh is None else jax.device_get(state.actor_params)
         weights.publish(p, step=int(jax.device_get(state.step)))
 
+    def train_steps(n: int):
+        nonlocal state
+        metrics = None
+        for _ in range(n):
+            if cfg.prioritized_replay:
+                step_now = int(jax.device_get(state.step))
+                batch, w, idx = service.sample(cfg.batch_size,
+                                               beta=beta.value(step_now))
+                if mesh is not None:
+                    batch = shard_batch(batch, mesh)
+                    w = shard_batch(jnp.asarray(w), mesh)
+                state, metrics = update(state, batch, jnp.asarray(w))
+                service.update_priorities(
+                    idx, np.abs(np.asarray(metrics["td_error"])) + 1e-6)
+            else:
+                batch = service.sample(cfg.batch_size)
+                if mesh is not None:
+                    batch = shard_batch(batch, mesh)
+                state, metrics = update(state, batch)
+        return metrics
+
+    stop_actors = threading.Event()
+    actor_threads: list[threading.Thread] = []
+    if cfg.async_actors:
+        def actor_loop(actor):
+            while not stop_actors.is_set():
+                if cfg.her:
+                    actor.run_episode(cfg.max_steps)
+                else:
+                    actor.run(50)
+
+        for actor in actors:
+            t = threading.Thread(target=actor_loop, args=(actor,), daemon=True)
+            t.start()
+            actor_threads.append(t)
+
+    timer = StepTimer()
     last_metrics: dict = {}
     for epoch in range(cfg.n_epochs):
         for cycle in range(cfg.n_cycles):
-            # collect
-            for actor in actors:
-                if cfg.her:
-                    for _ in range(cfg.episodes_per_cycle):
-                        actor.run_episode(cfg.max_steps)
-                else:
-                    ticks = cfg.episodes_per_cycle * cfg.max_steps // max(
-                        1, cfg.num_envs)
-                    actor.run(ticks)
-            service.flush()
-            # train
-            for _ in range(cfg.train_steps_per_cycle):
-                if cfg.prioritized_replay:
-                    step_now = int(jax.device_get(state.step))
-                    batch, w, idx = service.sample(cfg.batch_size,
-                                                   beta=beta.value(step_now))
-                    if mesh is not None:
-                        batch = shard_batch(batch, mesh)
-                        w = shard_batch(jnp.asarray(w), mesh)
-                    state, metrics = update(state, batch, jnp.asarray(w))
-                    service.update_priorities(
-                        idx, np.abs(np.asarray(metrics["td_error"])) + 1e-6)
-                else:
-                    batch = service.sample(cfg.batch_size)
-                    if mesh is not None:
-                        batch = shard_batch(batch, mesh)
-                    state, metrics = update(state, batch)
+            # collect (sync mode; async actors stream in the background)
+            if not cfg.async_actors:
+                for actor in actors:
+                    if cfg.her:
+                        for _ in range(cfg.episodes_per_cycle):
+                            actor.run_episode(cfg.max_steps)
+                    else:
+                        ticks = cfg.episodes_per_cycle * cfg.max_steps // max(
+                            1, cfg.num_envs)
+                        actor.run(ticks)
+                service.flush()
+            # train (trace the first cycle when profiling is enabled)
+            timer.start()
+            if epoch == 0 and cycle == 0 and cfg.profile_dir:
+                with xla_trace(cfg.profile_dir):
+                    metrics = train_steps(cfg.train_steps_per_cycle)
+            else:
+                metrics = train_steps(cfg.train_steps_per_cycle)
+            rate = timer.stop(cfg.train_steps_per_cycle)
             publish()
             # eval + log (main.py:309-353)
             eval_metrics = evaluator.evaluate(cfg.eval_trials,
@@ -209,14 +277,26 @@ def train(cfg: ExperimentConfig) -> dict:
                 "actor_loss": float(jax.device_get(metrics["actor_loss"])),
                 "env_steps": service.env_steps,
             }
+            if rate is not None:
+                last_metrics["grad_steps_per_sec"] = round(rate, 2)
+            dead = service.dead_actors()
+            if dead:
+                print(f"WARNING: actors missing heartbeats: {dead}", flush=True)
             bus.log(int(jax.device_get(state.step)), last_metrics)
             if (cycle + 1) % cfg.checkpoint_every == 0:
                 ckpt.save(
                     state if mesh is None else jax.device_get(state),
                     extra={"env_steps": service.env_steps},
                 )
+    stop_actors.set()
+    for t in actor_threads:
+        t.join(timeout=10.0)
     ckpt.wait()
     bus.close()
+    if receiver is not None:
+        receiver.close()
+    if weight_server is not None:
+        weight_server.close()
     service.close()
     for actor in actors:
         if cfg.her:

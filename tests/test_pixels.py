@@ -1,0 +1,83 @@
+"""Pixel (conv-encoder) path: uint8 replay storage, PixelActor/Critic
+through the jit'd update, and the full train driver on the fake pixel env
+(the DM-Control-from-pixels capability, BASELINE.md config #4 — no
+dm_control needed)."""
+
+import jax
+import numpy as np
+import pytest
+
+from d4pg_tpu.config import ExperimentConfig
+from d4pg_tpu.envs import PixelPointEnv
+from d4pg_tpu.learner import D4PGConfig, init_state, make_update
+from d4pg_tpu.replay import NStepFolder, ReplayBuffer
+from d4pg_tpu.replay.uniform import TransitionBatch
+
+SHAPE = (16, 16, 3)
+
+
+def test_pixel_buffer_uint8_storage(rng):
+    buf = ReplayBuffer(100, SHAPE, 2)
+    assert buf.obs.dtype == np.uint8
+    n = 8
+    batch = TransitionBatch(
+        obs=rng.integers(0, 255, (n, *SHAPE), dtype=np.uint8),
+        action=rng.uniform(-1, 1, (n, 2)).astype(np.float32),
+        reward=np.zeros(n, np.float32),
+        next_obs=rng.integers(0, 255, (n, *SHAPE), dtype=np.uint8),
+        done=np.zeros(n, np.float32),
+        discount=np.full(n, 0.99, np.float32),
+    )
+    buf.add(batch)
+    out = buf.sample(4)
+    assert out.obs.shape == (4, *SHAPE) and out.obs.dtype == np.uint8
+
+
+def test_pixel_nstep_folder(rng):
+    f = NStepFolder(2, 0.9, num_envs=1, obs_dim=SHAPE, act_dim=2)
+    for t in range(3):
+        out = f.step(
+            rng.integers(0, 255, (1, *SHAPE), dtype=np.uint8),
+            rng.uniform(-1, 1, (1, 2)).astype(np.float32),
+            np.array([1.0]),
+            rng.integers(0, 255, (1, *SHAPE), dtype=np.uint8),
+            np.array([False]),
+        )
+    assert out.obs.shape[0] == 1 and out.obs.dtype == np.uint8
+    assert out.reward[0] == pytest.approx(1.0 + 0.9)
+
+
+def test_pixel_learner_update(rng):
+    config = D4PGConfig(
+        obs_dim=int(np.prod(SHAPE)), act_dim=2, v_min=-20.0, v_max=0.0,
+        n_atoms=11, hidden=(32, 32), pixels=True, obs_shape=SHAPE,
+    )
+    assert config.obs_spec == SHAPE
+    state = init_state(config, jax.random.key(0))
+    update = make_update(config, donate=False, use_is_weights=False)
+    n = 8
+    batch = TransitionBatch(
+        obs=rng.integers(0, 255, (n, *SHAPE), dtype=np.uint8),
+        action=rng.uniform(-1, 1, (n, 2)).astype(np.float32),
+        reward=rng.standard_normal(n).astype(np.float32),
+        next_obs=rng.integers(0, 255, (n, *SHAPE), dtype=np.uint8),
+        done=np.zeros(n, np.float32),
+        discount=np.full(n, 0.99, np.float32),
+    )
+    state, metrics = update(state, batch)
+    assert np.isfinite(float(metrics["critic_loss"]))
+    assert int(state.step) == 1
+
+
+def test_pixel_train_end_to_end(tmp_path):
+    from d4pg_tpu.train import train
+
+    cfg = ExperimentConfig(
+        env="pixel-point", max_steps=10, num_envs=2, warmup=60, n_epochs=1,
+        n_cycles=1, episodes_per_cycle=1, train_steps_per_cycle=2,
+        eval_trials=1, batch_size=8, memory_size=500,
+        log_dir=str(tmp_path), hidden=(16, 16), n_atoms=11,
+        v_min=-20.0, v_max=0.0, n_steps=1,
+    )
+    metrics = train(cfg)
+    assert np.isfinite(metrics["critic_loss"])
