@@ -43,15 +43,7 @@ def _unflatten(flat: dict[str, np.ndarray]):
     return unflatten_dict(dict(flat), sep="/")
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
-    chunks = []
-    while n:
-        chunk = sock.recv(n)
-        if not chunk:
-            return None
-        chunks.append(chunk)
-        n -= len(chunk)
-    return b"".join(chunks)
+from d4pg_tpu.distributed.transport import _recv_exact
 
 
 class WeightServer:

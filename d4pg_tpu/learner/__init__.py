@@ -12,6 +12,8 @@ from d4pg_tpu.learner.state import D4PGConfig, D4PGState, init_state
 from d4pg_tpu.learner.update import (
     act,
     act_deterministic,
+    act_ou,
+    make_multi_update,
     make_update,
     update_step,
 )
@@ -22,6 +24,8 @@ __all__ = [
     "init_state",
     "act",
     "act_deterministic",
+    "act_ou",
+    "make_multi_update",
     "make_update",
     "update_step",
 ]

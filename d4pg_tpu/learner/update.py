@@ -166,6 +166,43 @@ def make_update(config: D4PGConfig, donate: bool = True, use_is_weights: bool = 
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
+def make_multi_update(
+    config: D4PGConfig, donate: bool = True, use_is_weights: bool = True
+):
+    """K updates per dispatch via ``lax.scan`` over stacked batches.
+
+    The single-step update is dispatch-bound on TPU (measured ~4.2k
+    steps/sec single vs ~69k at K=16 on one v5e chip, batch 256): each
+    step's compute is ~15us while the Python->device round trip costs
+    ~240us. Scanning K steps amortizes the dispatch. Semantically identical
+    to K sequential ``update_step`` calls (the PRNG chain threads through
+    the carried state); for PER the K priority updates land after the scan,
+    i.e. with staleness < K (standard in high-throughput actor-learner
+    pipelines).
+
+    Inputs carry a leading K axis: batch fields [K, B, ...], weights
+    [K, B]. Returns ``(state, metrics)`` with metrics stacked along K
+    (``td_error`` [K, B] feeds the batched priority write-back).
+    """
+    def scan_fn(state, batches, weights=None):
+        def body(s, xs):
+            if use_is_weights:
+                b, w = xs
+                s2, m = update_step(config, s, b, w)
+            else:
+                s2, m = update_step(config, s, xs, None)
+            return s2, m
+
+        xs = (batches, weights) if use_is_weights else batches
+        return jax.lax.scan(body, state, xs)
+
+    if use_is_weights:
+        fn = lambda state, batches, w: scan_fn(state, batches, w)
+    else:
+        fn = lambda state, batches: scan_fn(state, batches)
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+
 @partial(jax.jit, static_argnums=(0,))
 def act(
     config: D4PGConfig,

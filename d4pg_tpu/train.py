@@ -41,7 +41,7 @@ from d4pg_tpu.envs import (
 )
 from d4pg_tpu.io import CheckpointManager, CsvLogger, MetricsBus, TensorBoardSink
 from d4pg_tpu.io.profiling import StepTimer, xla_trace
-from d4pg_tpu.learner import init_state, make_update
+from d4pg_tpu.learner import init_state, make_multi_update, make_update
 from d4pg_tpu.parallel import (
     MeshSpec,
     make_mesh,
@@ -50,6 +50,7 @@ from d4pg_tpu.parallel import (
     shard_batch,
 )
 from d4pg_tpu.replay import LinearSchedule, PrioritizedReplayBuffer, ReplayBuffer
+from d4pg_tpu.replay.uniform import TransitionBatch
 
 
 def make_env_fn(cfg: ExperimentConfig, seed: int):
@@ -206,25 +207,71 @@ def train(cfg: ExperimentConfig) -> dict:
         p = state.actor_params if mesh is None else jax.device_get(state.actor_params)
         weights.publish(p, step=int(jax.device_get(state.step)))
 
-    def train_steps(n: int):
+    # Fused K-updates-per-dispatch path (single-device only: the stacked
+    # [K, B, ...] layout needs a different batch sharding than the mesh
+    # helper provides).
+    if mesh is not None and cfg.updates_per_dispatch > 1:
+        print("WARNING: --updates_per_dispatch is not supported with "
+              "--data_parallel > 1 yet; using single-dispatch updates",
+              flush=True)
+    K = max(1, cfg.updates_per_dispatch) if mesh is None else 1
+    multi_update = (
+        make_multi_update(config, donate=True,
+                          use_is_weights=cfg.prioritized_replay)
+        if K > 1 else None
+    )
+
+    def _stack_batches(batches):
+        return TransitionBatch(*[np.stack(x) for x in zip(*batches)])
+
+    def train_single():
         nonlocal state
+        if cfg.prioritized_replay:
+            step_now = int(jax.device_get(state.step))
+            batch, w, idx = service.sample(cfg.batch_size,
+                                           beta=beta.value(step_now))
+            if mesh is not None:
+                batch = shard_batch(batch, mesh)
+                w = shard_batch(jnp.asarray(w), mesh)
+            state, metrics = update(state, batch, jnp.asarray(w))
+            service.update_priorities(
+                idx, np.abs(np.asarray(metrics["td_error"])) + 1e-6)
+        else:
+            batch = service.sample(cfg.batch_size)
+            if mesh is not None:
+                batch = shard_batch(batch, mesh)
+            state, metrics = update(state, batch)
+        return metrics
+
+    def train_chunk(k: int):
+        """k scanned updates in one dispatch; PER priorities written back
+        after the scan (staleness < k)."""
+        nonlocal state
+        if cfg.prioritized_replay:
+            step_now = int(jax.device_get(state.step))
+            b = beta.value(step_now)
+            samples = [service.sample(cfg.batch_size, beta=b) for _ in range(k)]
+            batches = _stack_batches([s[0] for s in samples])
+            w = np.stack([s[1] for s in samples])
+            state, metrics = multi_update(state, batches, jnp.asarray(w))
+            td = np.abs(np.asarray(metrics["td_error"])) + 1e-6
+            for i, (_, _, idx) in enumerate(samples):
+                service.update_priorities(idx, td[i])
+        else:
+            batches = _stack_batches(
+                [service.sample(cfg.batch_size) for _ in range(k)])
+            state, metrics = multi_update(state, batches)
+        # last step's scalars for logging
+        return {name: value[-1] for name, value in metrics.items()}
+
+    def train_steps(n: int):
         metrics = None
-        for _ in range(n):
-            if cfg.prioritized_replay:
-                step_now = int(jax.device_get(state.step))
-                batch, w, idx = service.sample(cfg.batch_size,
-                                               beta=beta.value(step_now))
-                if mesh is not None:
-                    batch = shard_batch(batch, mesh)
-                    w = shard_batch(jnp.asarray(w), mesh)
-                state, metrics = update(state, batch, jnp.asarray(w))
-                service.update_priorities(
-                    idx, np.abs(np.asarray(metrics["td_error"])) + 1e-6)
-            else:
-                batch = service.sample(cfg.batch_size)
-                if mesh is not None:
-                    batch = shard_batch(batch, mesh)
-                state, metrics = update(state, batch)
+        remaining = n
+        while remaining >= K and K > 1:
+            metrics = train_chunk(K)
+            remaining -= K
+        for _ in range(remaining):
+            metrics = train_single()
         return metrics
 
     stop_actors = threading.Event()

@@ -141,6 +141,34 @@ def test_mog_family_end_to_end(rng):
     assert metrics["td_error"].shape == (B,)
 
 
+def test_multi_update_equals_sequential(rng):
+    """make_multi_update (lax.scan K-per-dispatch) must match K sequential
+    update_step calls bitwise — same PRNG chain, same Adam math."""
+    from d4pg_tpu.learner import make_multi_update
+
+    config = _config()
+    K = 3
+    batches = [_batch(np.random.default_rng(i)) for i in range(K)]
+    w = np.ones((K, B), np.float32)
+
+    seq_state = init_state(config, jax.random.key(11))
+    seq_update = make_update(config, donate=False)
+    for i in range(K):
+        seq_state, seq_m = seq_update(seq_state, batches[i], jnp.asarray(w[i]))
+
+    stacked = TransitionBatch(*[np.stack(x) for x in zip(*batches)])
+    multi_state = init_state(config, jax.random.key(11))
+    multi = make_multi_update(config, donate=False)
+    multi_state, multi_m = multi(multi_state, stacked, jnp.asarray(w))
+
+    assert int(multi_state.step) == K
+    np.testing.assert_array_equal(
+        np.asarray(multi_m["td_error"][-1]), np.asarray(seq_m["td_error"]))
+    for a, b in zip(jax.tree_util.tree_leaves(seq_state.critic_params),
+                    jax.tree_util.tree_leaves(multi_state.critic_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_act_shapes_and_bounds(rng):
     config = _config()
     state = init_state(config, jax.random.key(4))

@@ -70,10 +70,10 @@ def test_remote_actor_streams_to_learner():
     steps = run_actor(cfg, "127.0.0.1", receiver.port, server.port,
                       actor_id="remote-test", max_ticks=30)
     deadline = time.monotonic() + 5.0
-    while len(service) < 40 and time.monotonic() < deadline:
+    while len(service) < 41 and time.monotonic() < deadline:
         time.sleep(0.02)
     assert steps == 60  # 30 ticks x 2 envs
-    assert len(service) > 40  # n-step folding holds a few back
+    assert len(service) >= 41  # n-step folding holds a few back
     receiver.close()
     server.close()
     service.close()
