@@ -42,7 +42,8 @@ def bench_tpu(k: int = 16) -> float:
     from d4pg_tpu.replay.uniform import TransitionBatch
 
     config = D4PGConfig(obs_dim=OBS_DIM, act_dim=ACT_DIM, v_min=0.0,
-                        v_max=800.0, n_atoms=N_ATOMS, hidden=(256, 256, 256))
+                        v_max=800.0, n_atoms=N_ATOMS, hidden=(256, 256, 256),
+                        compute_dtype="bfloat16")
     state = init_state(config, jax.random.key(0))
     update = make_multi_update(config, donate=True, use_is_weights=True)
 

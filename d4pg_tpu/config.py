@@ -54,6 +54,7 @@ class ExperimentConfig:
     n_atoms: int = 51  # --n_atoms
     critic_family: str = "categorical"
     hidden: tuple = (256, 256, 256)
+    compute_dtype: str = "float32"  # 'bfloat16' for MXU-native matmuls
     # exploration
     noise: str = "gaussian"  # 'gaussian' | 'ou'
     epsilon_0: float = 0.3  # random_process.py:11
@@ -124,6 +125,7 @@ class ExperimentConfig:
             lr_critic=self.lr_critic,
             adam_b1=self.adam_b1,
             adam_b2=self.adam_b2,
+            compute_dtype=self.compute_dtype,
             tau=self.tau,
             gamma=self.gamma,
         )
@@ -169,6 +171,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n_atoms", type=int, default=d.n_atoms)
     p.add_argument("--critic_family", choices=("categorical", "mog"),
                    default=d.critic_family)
+    p.add_argument("--compute_dtype", choices=("float32", "bfloat16"),
+                   default=d.compute_dtype)
     p.add_argument("--noise", choices=("gaussian", "ou"), default=d.noise)
     p.add_argument("--epsilon_0", type=float, default=d.epsilon_0)
     p.add_argument("--ou_theta", type=float, default=d.ou_theta)

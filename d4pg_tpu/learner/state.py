@@ -61,12 +61,22 @@ class D4PGConfig:
     pixels: bool = False  # conv-encoder path (BASELINE.md config #4)
     obs_shape: tuple = ()  # [H, W, C] when pixels=True
     mog_samples: int = 32
+    # MXU compute dtype for the network matmuls ('float32' | 'bfloat16').
+    # Params, optimizer state, losses and the projection stay float32;
+    # bf16 matmuls measure ~1.5x the fused-dispatch update throughput.
+    compute_dtype: str = "float32"
 
     def __post_init__(self):
         object.__setattr__(self, "hidden", tuple(self.hidden))
         object.__setattr__(self, "obs_shape", tuple(self.obs_shape))
         if self.critic_family not in ("categorical", "mog"):
             raise ValueError(f"unknown critic_family {self.critic_family!r}")
+        if self.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
+
+    @property
+    def _dtype(self):
+        return jnp.bfloat16 if self.compute_dtype == "bfloat16" else jnp.float32
 
     @property
     def support(self) -> CategoricalSupport:
@@ -79,15 +89,19 @@ class D4PGConfig:
 
     def build_actor(self) -> nn.Module:
         if self.pixels:
-            return PixelActor(self.act_dim, hidden=self.hidden)
-        return Actor(self.act_dim, hidden=self.hidden)
+            return PixelActor(self.act_dim, hidden=self.hidden, dtype=self._dtype)
+        return Actor(self.act_dim, hidden=self.hidden, dtype=self._dtype)
 
     def build_critic(self) -> nn.Module:
         if self.critic_family == "mog":
-            return MixtureOfGaussianCritic(self.n_components, hidden=self.hidden)
+            return MixtureOfGaussianCritic(
+                self.n_components, hidden=self.hidden, dtype=self._dtype
+            )
         if self.pixels:
-            return PixelCategoricalCritic(self.n_atoms, hidden=self.hidden)
-        return CategoricalCritic(self.n_atoms, hidden=self.hidden)
+            return PixelCategoricalCritic(
+                self.n_atoms, hidden=self.hidden, dtype=self._dtype
+            )
+        return CategoricalCritic(self.n_atoms, hidden=self.hidden, dtype=self._dtype)
 
     def optimizer(self, lr: float) -> optax.GradientTransformation:
         return optax.adam(lr, b1=self.adam_b1, b2=self.adam_b2)

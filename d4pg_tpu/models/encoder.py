@@ -50,11 +50,12 @@ class PixelActor(nn.Module):
     act_dim: int
     latent_dim: int = 50
     hidden: Sequence[int] = (256, 256, 256)
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, pixels: jnp.ndarray) -> jnp.ndarray:
-        z = PixelEncoder(self.latent_dim, name="encoder")(pixels)
-        return Actor(self.act_dim, self.hidden, name="actor")(z)
+        z = PixelEncoder(self.latent_dim, dtype=self.dtype, name="encoder")(pixels)
+        return Actor(self.act_dim, self.hidden, dtype=self.dtype, name="actor")(z)
 
 
 class PixelCategoricalCritic(nn.Module):
@@ -63,12 +64,12 @@ class PixelCategoricalCritic(nn.Module):
     n_atoms: int = 51
     latent_dim: int = 50
     hidden: Sequence[int] = (256, 256, 256)
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(
         self, pixels: jnp.ndarray, action: jnp.ndarray, return_logits: bool = False
     ) -> jnp.ndarray:
-        z = PixelEncoder(self.latent_dim, name="encoder")(pixels)
-        return CategoricalCritic(self.n_atoms, self.hidden, name="critic")(
-            z, action, return_logits
-        )
+        z = PixelEncoder(self.latent_dim, dtype=self.dtype, name="encoder")(pixels)
+        return CategoricalCritic(self.n_atoms, self.hidden, dtype=self.dtype,
+                                 name="critic")(z, action, return_logits)

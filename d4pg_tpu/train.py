@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import os
 import threading
+import traceback
 
 import jax
 import jax.numpy as jnp
@@ -275,19 +276,38 @@ def train(cfg: ExperimentConfig) -> dict:
         return metrics
 
     stop_actors = threading.Event()
-    actor_threads: list[threading.Thread] = []
-    if cfg.async_actors:
-        def actor_loop(actor):
+    actor_threads: dict[int, threading.Thread] = {}
+
+    def actor_loop(actor):
+        try:
             while not stop_actors.is_set():
                 if cfg.her:
                     actor.run_episode(cfg.max_steps)
                 else:
                     actor.run(50)
+        except Exception:  # noqa: BLE001 — actor crash must not kill training
+            # Log and EXIT the thread; the once-per-cycle supervisor
+            # respawns it, which also rate-limits a permanently failing
+            # actor to one attempt per cycle.
+            print(f"actor {actor.actor_id} crashed:\n{traceback.format_exc()}",
+                  flush=True)
 
-        for actor in actors:
-            t = threading.Thread(target=actor_loop, args=(actor,), daemon=True)
-            t.start()
-            actor_threads.append(t)
+    def start_actor_thread(i: int):
+        t = threading.Thread(target=actor_loop, args=(actors[i],), daemon=True)
+        t.start()
+        actor_threads[i] = t
+
+    def supervise_actors():
+        """Failure recovery (SURVEY.md §5 — the reference has none): actors
+        are stateless-restartable, so a dead thread is simply respawned."""
+        for i, t in list(actor_threads.items()):
+            if not t.is_alive() and not stop_actors.is_set():
+                print(f"supervisor: restarting actor thread {i}", flush=True)
+                start_actor_thread(i)
+
+    if cfg.async_actors:
+        for i in range(len(actors)):
+            start_actor_thread(i)
 
     timer = StepTimer()
     last_metrics: dict = {}
@@ -329,6 +349,8 @@ def train(cfg: ExperimentConfig) -> dict:
             dead = service.dead_actors()
             if dead:
                 print(f"WARNING: actors missing heartbeats: {dead}", flush=True)
+            if cfg.async_actors:
+                supervise_actors()
             bus.log(int(jax.device_get(state.step)), last_metrics)
             if (cycle + 1) % cfg.checkpoint_every == 0:
                 ckpt.save(
@@ -336,7 +358,7 @@ def train(cfg: ExperimentConfig) -> dict:
                     extra={"env_steps": service.env_steps},
                 )
     stop_actors.set()
-    for t in actor_threads:
+    for t in actor_threads.values():
         t.join(timeout=10.0)
     ckpt.wait()
     bus.close()

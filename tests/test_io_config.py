@@ -132,3 +132,24 @@ def test_train_entrypoint_end_to_end(tmp_path):
     run_dir = os.path.join(str(tmp_path), cfg.run_name())
     assert os.path.exists(os.path.join(run_dir, "returns.csv"))
     assert os.path.isdir(os.path.join(run_dir, "ckpt"))
+
+
+def test_full_train_determinism(tmp_path):
+    """System-level determinism (SURVEY.md §5): two identical sync-mode
+    runs produce identical eval trajectories — the property the reference's
+    hogwild design cannot have."""
+    from d4pg_tpu.train import train
+
+    def run(tag):
+        cfg = ExperimentConfig(
+            env="point", max_steps=20, num_envs=2, warmup=100, n_epochs=1,
+            n_cycles=2, episodes_per_cycle=2, train_steps_per_cycle=4,
+            eval_trials=2, batch_size=16, memory_size=2000,
+            log_dir=str(tmp_path / tag), hidden=(16, 16), n_atoms=11,
+            v_min=-5.0, v_max=0.0, seed=123,
+        )
+        train(cfg)
+        csv = os.path.join(str(tmp_path / tag), cfg.run_name(), "returns.csv")
+        return open(csv).read()
+
+    assert run("a") == run("b")
