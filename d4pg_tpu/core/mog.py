@@ -16,13 +16,17 @@ branch an empty TODO (``models.py:63-65, 85-87``; ``ddpg.py:48-50,
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 from jax import Array
 
 from d4pg_tpu.models.critic import MoGParams
 
-_LOG2PI = jnp.log(2.0 * jnp.pi)
+# Plain Python float: a module-level jnp call would initialize the default
+# backend at import time, before callers can select a platform.
+_LOG2PI = math.log(2.0 * math.pi)
 
 
 def mog_log_prob(params: MoGParams, x: Array) -> Array:
