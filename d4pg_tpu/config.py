@@ -40,8 +40,10 @@ class ExperimentConfig:
     n_steps: int = 3  # --n_steps
     # K learner updates fused into one device dispatch via lax.scan
     # (~16x single-dispatch throughput at K=16 on one chip; PER priority
-    # write-back then lags by < K steps). 1 = exact reference semantics.
-    updates_per_dispatch: int = 1
+    # write-back then lags by <= 2K steps with the prefetch pipeline).
+    # Composes with data_parallel (batches sharded P(None, 'data')).
+    # 1 = exact reference semantics (write-back every step).
+    updates_per_dispatch: int = 8
     # algorithm
     gamma: float = 0.99  # --gamma
     tau: float = 0.001  # --tau
@@ -69,6 +71,9 @@ class ExperimentConfig:
     episodes_per_cycle: int = 16
     train_steps_per_cycle: int = 40
     eval_trials: int = 10
+    # Evaluate on a background thread (the reference's separate evaluator
+    # process, main.py:395-397); 0 = inline on the learner thread.
+    concurrent_eval: bool = True
     # distributed
     n_workers: int = 1  # --n_workers (actor count)
     data_parallel: int = 1  # learner mesh data axis (1 = single device)
@@ -184,6 +189,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--train_steps_per_cycle", type=int,
                    default=d.train_steps_per_cycle)
     p.add_argument("--eval_trials", type=int, default=d.eval_trials)
+    _add_bool_flag(p, "concurrent_eval", d.concurrent_eval,
+                   "evaluate on a background thread")
     p.add_argument("--n_workers", type=int, default=d.n_workers)
     p.add_argument("--data_parallel", type=int, default=d.data_parallel)
     _add_bool_flag(p, "async_actors", d.async_actors,
@@ -209,4 +216,5 @@ def parse_args(argv=None) -> ExperimentConfig:
     ns["debug"] = bool(ns["debug"])
     ns["async_actors"] = bool(ns["async_actors"])
     ns["serve"] = bool(ns["serve"])
+    ns["concurrent_eval"] = bool(ns["concurrent_eval"])
     return ExperimentConfig(**ns)

@@ -19,7 +19,7 @@ D4PG paper shape the reference only gestures at (SURVEY.md §2):
 from d4pg_tpu.distributed.weights import WeightStore
 from d4pg_tpu.distributed.replay_service import ReplayService
 from d4pg_tpu.distributed.actor import ActorConfig, ActorWorker
-from d4pg_tpu.distributed.evaluator import Evaluator
+from d4pg_tpu.distributed.evaluator import AsyncEvaluator, Evaluator
 from d4pg_tpu.distributed.transport import (
     TransitionReceiver,
     TransitionSender,
@@ -30,6 +30,7 @@ __all__ = [
     "ReplayService",
     "ActorConfig",
     "ActorWorker",
+    "AsyncEvaluator",
     "Evaluator",
     "TransitionReceiver",
     "TransitionSender",

@@ -24,7 +24,7 @@ import numpy as np
 
 from d4pg_tpu.envs.her import her_relabel
 from d4pg_tpu.envs.vector import EnvPool
-from d4pg_tpu.envs.wrappers import flatten_goal_obs
+from d4pg_tpu.envs.wrappers import flatten_goal_obs, rescale_action
 from d4pg_tpu.core.noise import ou
 from d4pg_tpu.learner.state import D4PGConfig
 from d4pg_tpu.learner.update import act, act_ou
@@ -214,6 +214,15 @@ class GoalActorWorker(_BaseActor):
         self.env = env
         self.her_ratio = her_ratio
         self._np_rng = np.random.default_rng(rng_seed)
+        # The policy lives in tanh range (-1, 1); the env may not. The
+        # reference wraps EVERY worker env — HER included — in
+        # NormalizeAction (``main.py:190``, ``normalize_env.py:5-8``); round 1
+        # stepped the raw tanh action here while the Evaluator rescaled,
+        # giving training and eval different dynamics on any goal env whose
+        # action box isn't (-1, 1). Stored transitions keep the tanh-space
+        # action, matching EnvPool/Evaluator.
+        self._act_low = np.asarray(env.action_space.low, np.float32)
+        self._act_high = np.asarray(env.action_space.high, np.float32)
 
     def run_episode(self, max_steps: int) -> int:
         env = self.env
@@ -224,7 +233,9 @@ class GoalActorWorker(_BaseActor):
         for _ in range(max_steps):
             flat = flatten_goal_obs(obs_dict)
             a = self._explore_actions(flat[None])[0]
-            nobs_dict, r, term, trunc, info = env.step(a)
+            nobs_dict, r, term, trunc, info = env.step(
+                rescale_action(a, self._act_low, self._act_high)
+            )
             raw_obs.append(np.asarray(obs_dict["observation"], np.float32).copy())
             actions.append(a)
             next_raw.append(np.asarray(nobs_dict["observation"], np.float32).copy())

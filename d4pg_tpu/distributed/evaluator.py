@@ -11,6 +11,8 @@ instead of appending to a process-local list the parent never sees
 
 from __future__ import annotations
 
+import queue
+import threading
 from typing import Callable, Optional
 
 import jax.numpy as jnp
@@ -64,7 +66,10 @@ class Evaluator:
     def evaluate(self, n_trials: int = 10, seed: int | None = None) -> dict:
         """Run n greedy trials; returns metrics incl. EWMA'd return and
         success rate (``main.py:309-353``)."""
-        _, params = self.weights.get()
+        # Snapshot step WITH the params: the learner may publish again while
+        # the rollouts run, and ``learner_step`` must describe the weights
+        # actually evaluated (it feeds the eval_lag_steps metric).
+        _, params, published_step = self.weights.snapshot()
         if params is None:
             raise RuntimeError("no weights published yet")
         returns, successes = [], []
@@ -82,5 +87,84 @@ class Evaluator:
             "avg_test_reward": avg,
             "ewma_test_reward": self.ewma_return,
             "success_rate": float(np.mean(successes)),
-            "learner_step": self.weights.step,
+            "learner_step": published_step,
         }
+
+
+class AsyncEvaluator:
+    """Concurrent evaluation off the learner thread.
+
+    The reference evaluates in a SEPARATE process while training continues
+    (``main.py:395-397``); round 1 ran ``Evaluator.evaluate`` inline on the
+    learner thread, stalling every cycle for the rollouts. This wrapper owns
+    a background thread: the learner ``request()``s an eval (non-blocking;
+    coalesced if one is already running) and reads the most recent completed
+    result via ``latest()``. Results carry the ``learner_step`` the weights
+    were published at, so the logged ``eval_lag_steps`` is observable.
+    """
+
+    def __init__(self, evaluator: Evaluator):
+        self._ev = evaluator
+        self._requests: queue.Queue = queue.Queue(maxsize=1)
+        self._latest: Optional[dict] = None
+        self._lock = threading.Lock()
+        # Accepted-but-not-finished request count. Incremented in request()
+        # BEFORE the queue put and decremented only after the eval (or its
+        # failure) completes, so wait() cannot slip through the window
+        # between the worker's dequeue and the start of the rollouts.
+        self._outstanding = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def request(self, n_trials: int, seed: int | None = None) -> bool:
+        """Enqueue an eval against the CURRENT WeightStore contents. Returns
+        False (dropped) if an eval is already queued — the learner never
+        waits."""
+        with self._lock:
+            self._outstanding += 1
+        try:
+            self._requests.put_nowait((n_trials, seed))
+            return True
+        except queue.Full:
+            with self._lock:
+                self._outstanding -= 1
+            return False
+
+    def latest(self) -> Optional[dict]:
+        """Most recent completed eval metrics (None until the first one)."""
+        with self._lock:
+            return None if self._latest is None else dict(self._latest)
+
+    def wait(self, timeout: float = 300.0) -> Optional[dict]:
+        """Drain pending requests and return the final metrics (shutdown /
+        end-of-training path)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._outstanding == 0:
+                    break
+            time.sleep(0.01)
+        return self.latest()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                n_trials, seed = self._requests.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                result = self._ev.evaluate(n_trials, seed=seed)
+                with self._lock:
+                    self._latest = result
+            except Exception as e:  # noqa: BLE001 — eval crash must not kill training
+                print(f"evaluator failed: {e!r}", flush=True)
+            finally:
+                with self._lock:
+                    self._outstanding -= 1
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10.0)

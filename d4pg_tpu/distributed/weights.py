@@ -54,6 +54,14 @@ class WeightStore:
         with self._lock:
             return self._version, self._params
 
+    def snapshot(self) -> tuple[int, Any, int]:
+        """(version, params, step) read atomically — use when the caller
+        needs the step the params were published at (e.g. eval lag
+        accounting); reading ``.step`` separately can observe a newer
+        publish."""
+        with self._lock:
+            return self._version, self._params, self._step
+
     def get_if_newer(self, have_version: int) -> tuple[int, Any] | None:
         with self._lock:
             if self._version > have_version:

@@ -1,0 +1,90 @@
+"""Pipelined K-chunk learner loop — the shipped hot path.
+
+One place implements the sample -> stage -> scanned-update -> priority
+write-back pipeline so ``train.py`` and ``bench.py`` measure and ship the
+SAME loop (the reference scope per step is ``ddpg.py:200-255``: sample,
+nets, projection, optimizer, priorities). Schedule per chunk t:
+
+  1. take the staged chunk t (sampled/device_put while t-1 computed),
+     and immediately stage chunk t+1 (host work, overlaps device),
+  2. dispatch the K-step scanned update for chunk t (async),
+  3. write back chunk t-1's PER priorities (blocks only on t-1's
+     td_error, which is ready or nearly so).
+
+PER priorities therefore land with staleness <= 2K grad steps (Ape-X-style
+bounded lag); ``updates_per_dispatch=1`` in the config restores exact
+per-step write-back semantics via the non-pipelined path in ``train.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from d4pg_tpu.replay.staging import DeviceStager
+
+
+class ChunkPipeline:
+    """Drives ``multi_update`` over prefetched chunks.
+
+    ``sample_fn() -> ((batches, weights), aux)``: host-side sample of one
+    [K, B, ...] chunk; ``weights``/``aux`` are None for uniform replay.
+    ``write_back(aux, td)``: PER priority update, td shaped [K, B].
+    ``sharding``: optional NamedSharding for the staged chunk (mesh path).
+    """
+
+    def __init__(
+        self,
+        update_fn: Callable,
+        sample_fn: Callable[[], tuple],
+        write_back: Optional[Callable[[Any, np.ndarray], None]] = None,
+        sharding=None,
+        use_weights: bool = True,
+    ):
+        self._update = update_fn
+        self._write_back = write_back
+        self._use_weights = use_weights
+        self._stager = DeviceStager(sample_fn, device=sharding, with_aux=True)
+
+    def invalidate(self) -> None:
+        """Drop the staged chunk (sync-mode cycle boundary: train only on
+        post-collect samples)."""
+        self._stager.invalidate()
+
+    def run(
+        self,
+        state,
+        n_chunks: int,
+        on_chunk: Optional[Callable] = None,
+        final_prefetch: bool = True,
+    ):
+        """Run ``n_chunks`` pipelined dispatches; returns (state, metrics of
+        the last chunk, stacked [K]). ``on_chunk(state)`` fires after each
+        dispatch (step accounting, weight publishing). Pass
+        ``final_prefetch=False`` when the caller will ``invalidate()``
+        before the next run (avoids staging a chunk only to discard it)."""
+        metrics = None
+        pending = None
+        for i in range(n_chunks):
+            prefetch = final_prefetch or (i + 1 < n_chunks)
+            (batches, w), aux = self._stager.next(prefetch=prefetch)
+            if self._use_weights:
+                state, metrics = self._update(state, batches, w)
+            else:
+                state, metrics = self._update(state, batches)
+            if pending is not None:
+                self._flush(pending)
+            pending = (aux, metrics)
+            if on_chunk is not None:
+                on_chunk(state)
+        if pending is not None:
+            self._flush(pending)
+        return state, metrics
+
+    def _flush(self, pending) -> None:
+        aux, metrics = pending
+        if aux is None or self._write_back is None:
+            return
+        td = np.abs(np.asarray(metrics["td_error"])) + 1e-6
+        self._write_back(aux, td)

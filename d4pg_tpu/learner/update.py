@@ -166,10 +166,34 @@ def make_update(config: D4PGConfig, donate: bool = True, use_is_weights: bool = 
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
+def multi_update_step(
+    config: D4PGConfig,
+    state: D4PGState,
+    batches: TransitionBatch,
+    weights: Array | None = None,
+):
+    """K sequential updates via ``lax.scan`` over stacked batches — the pure
+    function behind :func:`make_multi_update` and the mesh-sharded variant
+    (``parallel.data_parallel.make_sharded_multi_update``).
+
+    Inputs carry a leading K axis: batch fields [K, B, ...], weights
+    [K, B]. Returns ``(state, metrics)`` with metrics stacked along K
+    (``td_error`` [K, B] feeds the batched priority write-back).
+    """
+    def body(s, xs):
+        if weights is not None:
+            b, w = xs
+            return update_step(config, s, b, w)
+        return update_step(config, s, xs, None)
+
+    xs = (batches, weights) if weights is not None else batches
+    return jax.lax.scan(body, state, xs)
+
+
 def make_multi_update(
     config: D4PGConfig, donate: bool = True, use_is_weights: bool = True
 ):
-    """K updates per dispatch via ``lax.scan`` over stacked batches.
+    """jit :func:`multi_update_step` (K updates per device dispatch).
 
     The single-step update is dispatch-bound on TPU (measured ~4.2k
     steps/sec single vs ~69k at K=16 on one v5e chip, batch 256): each
@@ -179,27 +203,11 @@ def make_multi_update(
     the carried state); for PER the K priority updates land after the scan,
     i.e. with staleness < K (standard in high-throughput actor-learner
     pipelines).
-
-    Inputs carry a leading K axis: batch fields [K, B, ...], weights
-    [K, B]. Returns ``(state, metrics)`` with metrics stacked along K
-    (``td_error`` [K, B] feeds the batched priority write-back).
     """
-    def scan_fn(state, batches, weights=None):
-        def body(s, xs):
-            if use_is_weights:
-                b, w = xs
-                s2, m = update_step(config, s, b, w)
-            else:
-                s2, m = update_step(config, s, xs, None)
-            return s2, m
-
-        xs = (batches, weights) if use_is_weights else batches
-        return jax.lax.scan(body, state, xs)
-
     if use_is_weights:
-        fn = lambda state, batches, w: scan_fn(state, batches, w)
+        fn = lambda state, batches, w: multi_update_step(config, state, batches, w)
     else:
-        fn = lambda state, batches: scan_fn(state, batches)
+        fn = lambda state, batches: multi_update_step(config, state, batches)
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 

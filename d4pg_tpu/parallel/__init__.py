@@ -12,15 +12,19 @@ the pixel-encoder config can shard activations later (SURVEY.md §2 mandate).
 
 from d4pg_tpu.parallel.mesh import MeshSpec, make_mesh
 from d4pg_tpu.parallel.data_parallel import (
+    make_sharded_multi_update,
     make_sharded_update,
     replicate_state,
     shard_batch,
+    shard_stacked,
 )
 
 __all__ = [
     "MeshSpec",
     "make_mesh",
+    "make_sharded_multi_update",
     "make_sharded_update",
     "replicate_state",
     "shard_batch",
+    "shard_stacked",
 ]

@@ -20,7 +20,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from d4pg_tpu.learner.state import D4PGConfig, D4PGState
-from d4pg_tpu.learner.update import update_step
+from d4pg_tpu.learner.update import multi_update_step, update_step
 from d4pg_tpu.replay.uniform import TransitionBatch
 
 from d4pg_tpu.parallel.mesh import DATA_AXIS
@@ -34,6 +34,11 @@ def _batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
+def _stacked_sharding(mesh: Mesh) -> NamedSharding:
+    """[K, B, ...] stacks: K replicated (scan axis), B split over ``data``."""
+    return NamedSharding(mesh, P(None, DATA_AXIS))
+
+
 def replicate_state(state: D4PGState, mesh: Mesh) -> D4PGState:
     """Place the train state fully replicated over the mesh."""
     return jax.device_put(state, _replicated(mesh))
@@ -43,6 +48,13 @@ def shard_batch(batch: TransitionBatch, mesh: Mesh) -> TransitionBatch:
     """Shard a host batch over the ``data`` axis (leading dim split across
     the mesh's data dimension). The batch size must divide evenly."""
     return jax.device_put(batch, _batch_sharding(mesh))
+
+
+def shard_stacked(batches, mesh: Mesh):
+    """Shard a [K, B, ...] stack of batches: the scan axis K stays
+    replicated, B splits over ``data``. Works on any pytree whose leaves
+    carry the [K, B, ...] layout (TransitionBatch stacks, weight stacks)."""
+    return jax.device_put(batches, _stacked_sharding(mesh))
 
 
 def make_sharded_update(
@@ -75,6 +87,44 @@ def make_sharded_update(
     else:
         fn = lambda state, batch: update_step(config, state, batch, None)
         in_shardings = (repl, shard)
+    return jax.jit(
+        fn,
+        in_shardings=in_shardings,
+        out_shardings=(repl, out_metrics),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def make_sharded_multi_update(
+    config: D4PGConfig,
+    mesh: Mesh,
+    donate: bool = True,
+    use_is_weights: bool = True,
+):
+    """jit the K-step scanned update with explicit shardings over ``mesh`` —
+    the production configuration: dispatch amortization (K ``lax.scan``
+    steps per device round trip) COMBINED with data parallelism (each step's
+    [B, ...] batch split over the ``data`` axis, gradients all-reduced by
+    XLA-inserted collectives over ICI).
+
+    in: state replicated, batches [K, B, ...] + weights [K, B] sharded
+    ``P(None, 'data')``. out: state replicated, scalar metrics stacked [K]
+    replicated, ``td_error`` [K, B] sharded ``P(None, 'data')``.
+    """
+    repl = _replicated(mesh)
+    stacked = _stacked_sharding(mesh)
+    out_metrics = {
+        "critic_loss": repl,
+        "actor_loss": repl,
+        "q_mean": repl,
+        "td_error": stacked,
+    }
+    if use_is_weights:
+        fn = lambda state, batches, w: multi_update_step(config, state, batches, w)
+        in_shardings: tuple = (repl, stacked, stacked)
+    else:
+        fn = lambda state, batches: multi_update_step(config, state, batches)
+        in_shardings = (repl, stacked)
     return jax.jit(
         fn,
         in_shardings=in_shardings,

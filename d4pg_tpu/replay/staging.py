@@ -15,29 +15,49 @@ import jax
 
 
 class DeviceStager:
-    """Double-buffered prefetch of host batches onto a device (or sharding)."""
+    """Double-buffered prefetch of host batches onto a device (or sharding).
+
+    With ``with_aux=True`` the sample_fn returns ``(payload, aux)``: the
+    payload is ``device_put`` (async), the aux rides along untouched on the
+    host — e.g. PER sample indices that must come back to the host for the
+    priority write-back (``ddpg.py:252-255``).
+    """
 
     def __init__(
         self,
         sample_fn: Callable[[], object],
         device=None,
+        with_aux: bool = False,
     ):
         self._sample = sample_fn
         self._device = device
+        self._with_aux = with_aux
         self._inflight = None
 
     def _put(self):
-        batch = self._sample()
-        if self._device is not None:
-            return jax.device_put(batch, self._device)
-        return jax.device_put(batch)
+        sampled = self._sample()
+        batch, aux = sampled if self._with_aux else (sampled, None)
+        staged = (
+            jax.device_put(batch, self._device)
+            if self._device is not None
+            else jax.device_put(batch)
+        )
+        return (staged, aux) if self._with_aux else staged
 
-    def next(self):
-        """Return the prefetched batch and immediately start staging the
-        following one."""
+    def next(self, prefetch: bool = True):
+        """Return the prefetched batch and (unless ``prefetch=False``) start
+        staging the following one. Pass ``prefetch=False`` on the last batch
+        a consumer will take before an ``invalidate()`` — otherwise that
+        trailing sample is staged only to be thrown away."""
         out = self._inflight if self._inflight is not None else self._put()
-        self._inflight = self._put()
+        self._inflight = self._put() if prefetch else None
         return out
+
+    def invalidate(self) -> None:
+        """Drop the in-flight batch (e.g. after a buffer mutation that makes
+        the prefetched sample undesirable). The next ``next()`` samples
+        fresh."""
+        self._inflight = None
 
     def __iter__(self) -> Iterator:
         while True:

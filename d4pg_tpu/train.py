@@ -18,16 +18,19 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 import traceback
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from d4pg_tpu.config import ExperimentConfig, parse_args
 from d4pg_tpu.distributed import (
     ActorConfig,
     ActorWorker,
+    AsyncEvaluator,
     Evaluator,
     ReplayService,
     WeightStore,
@@ -43,13 +46,16 @@ from d4pg_tpu.envs import (
 from d4pg_tpu.io import CheckpointManager, CsvLogger, MetricsBus, TensorBoardSink
 from d4pg_tpu.io.profiling import StepTimer, xla_trace
 from d4pg_tpu.learner import init_state, make_multi_update, make_update
+from d4pg_tpu.learner.pipeline import ChunkPipeline
 from d4pg_tpu.parallel import (
     MeshSpec,
     make_mesh,
+    make_sharded_multi_update,
     make_sharded_update,
     replicate_state,
     shard_batch,
 )
+from d4pg_tpu.parallel.mesh import DATA_AXIS
 from d4pg_tpu.replay import LinearSchedule, PrioritizedReplayBuffer, ReplayBuffer
 from d4pg_tpu.replay.uniform import TransitionBatch
 
@@ -107,7 +113,8 @@ def train(cfg: ExperimentConfig) -> dict:
     state = init_state(config, jax.random.key(cfg.seed))
     mesh = None
     if cfg.data_parallel > 1:
-        mesh = make_mesh(MeshSpec(data_parallel=cfg.data_parallel))
+        mesh = make_mesh(MeshSpec(data_parallel=cfg.data_parallel),
+                         devices=jax.devices()[:cfg.data_parallel])
         state = replicate_state(state, mesh)
         update = make_sharded_update(config, mesh, donate=True,
                                      use_is_weights=cfg.prioritized_replay)
@@ -176,6 +183,10 @@ def train(cfg: ExperimentConfig) -> dict:
         actors.append(actor)
     evaluator = Evaluator(config, make_env_fn(cfg, seed=cfg.seed + 777), weights,
                           max_steps=cfg.max_steps, goal_conditioned=cfg.her)
+    # Concurrent eval (main.py:395-397: the reference's evaluator is a
+    # separate process): greedy rollouts run on a background thread against
+    # published weights; the learner never blocks on them.
+    async_eval = AsyncEvaluator(evaluator) if cfg.concurrent_eval else None
 
     # --- warmup (main.py:200-207) ----------------------------------------
     warmup_ticks = max(1, cfg.warmup // max(1, cfg.num_envs))
@@ -204,37 +215,83 @@ def train(cfg: ExperimentConfig) -> dict:
 
     # --- the HER-paper loop (main.py:299-368), or the decoupled async
     # actor-learner architecture of the D4PG paper (--async_actors 1) ------
+    # ``lstep`` mirrors the device step counter on the host (exact: we know
+    # how many updates each dispatch performs), so beta/metrics never force
+    # a device sync mid-pipeline.
+    lstep = int(jax.device_get(state.step))
+
     def publish():
         p = state.actor_params if mesh is None else jax.device_get(state.actor_params)
-        weights.publish(p, step=int(jax.device_get(state.step)))
+        weights.publish(p, step=lstep)
 
-    # Fused K-updates-per-dispatch path (single-device only: the stacked
-    # [K, B, ...] layout needs a different batch sharding than the mesh
-    # helper provides).
-    if mesh is not None and cfg.updates_per_dispatch > 1:
-        print("WARNING: --updates_per_dispatch is not supported with "
-              "--data_parallel > 1 yet; using single-dispatch updates",
-              flush=True)
-    K = max(1, cfg.updates_per_dispatch) if mesh is None else 1
-    multi_update = (
-        make_multi_update(config, donate=True,
-                          use_is_weights=cfg.prioritized_replay)
-        if K > 1 else None
+    # Fused K-updates-per-dispatch path. With a mesh this composes with
+    # data parallelism: batches are stacked [K, B, ...] with K replicated
+    # (the scan axis) and B sharded over ``data``.
+    K = max(1, cfg.updates_per_dispatch)
+    if K > 1:
+        if mesh is not None:
+            multi_update = make_sharded_multi_update(
+                config, mesh, donate=True,
+                use_is_weights=cfg.prioritized_replay)
+        else:
+            multi_update = make_multi_update(
+                config, donate=True, use_is_weights=cfg.prioritized_replay)
+    else:
+        multi_update = None
+    stacked_sharding = (
+        NamedSharding(mesh, P(None, DATA_AXIS)) if mesh is not None else None
     )
 
     def _stack_batches(batches):
         return TransitionBatch(*[np.stack(x) for x in zip(*batches)])
 
-    def train_single():
-        nonlocal state
+    def _sample_chunk():
+        """Host-side sample of one K-chunk; returns (device payload, idx aux)."""
         if cfg.prioritized_replay:
-            step_now = int(jax.device_get(state.step))
+            b = beta.value(lstep)
+            samples = [service.sample(cfg.batch_size, beta=b) for _ in range(K)]
+            batches = _stack_batches([s[0] for s in samples])
+            w = np.stack([s[1] for s in samples]).astype(np.float32)
+            return (batches, w), [s[2] for s in samples]
+        batches = _stack_batches(
+            [service.sample(cfg.batch_size) for _ in range(K)])
+        return (batches, None), None
+
+    # Double-buffered host->device staging (SURVEY.md §7 "hard parts"):
+    # while the device runs chunk t's scanned update, the host samples and
+    # device_puts chunk t+1; PER priority staleness is bounded by 2K steps.
+    # The pipeline itself lives in learner/pipeline.py, shared with bench.py
+    # so the benchmarked loop IS the shipped loop.
+    def _per_write_back(idx_list, td):
+        for i, idx in enumerate(idx_list):
+            service.update_priorities(idx, td[i])
+
+    pipeline = (
+        ChunkPipeline(
+            multi_update, _sample_chunk,
+            write_back=_per_write_back if cfg.prioritized_replay else None,
+            sharding=stacked_sharding,
+            use_weights=cfg.prioritized_replay,
+        )
+        if K > 1 else None
+    )
+
+    def _on_chunk(_state):
+        nonlocal lstep
+        lstep += K
+        if cfg.async_actors:
+            publish()  # bounded weight staleness: lag <= K steps
+
+    def train_single():
+        nonlocal state, lstep
+        if cfg.prioritized_replay:
             batch, w, idx = service.sample(cfg.batch_size,
-                                           beta=beta.value(step_now))
+                                           beta=beta.value(lstep))
             if mesh is not None:
                 batch = shard_batch(batch, mesh)
                 w = shard_batch(jnp.asarray(w), mesh)
             state, metrics = update(state, batch, jnp.asarray(w))
+            lstep += 1
             service.update_priorities(
                 idx, np.abs(np.asarray(metrics["td_error"])) + 1e-6)
         else:
@@ -242,38 +299,34 @@ def train(cfg: ExperimentConfig) -> dict:
             if mesh is not None:
                 batch = shard_batch(batch, mesh)
             state, metrics = update(state, batch)
+            lstep += 1
         return metrics
-
-    def train_chunk(k: int):
-        """k scanned updates in one dispatch; PER priorities written back
-        after the scan (staleness < k)."""
-        nonlocal state
-        if cfg.prioritized_replay:
-            step_now = int(jax.device_get(state.step))
-            b = beta.value(step_now)
-            samples = [service.sample(cfg.batch_size, beta=b) for _ in range(k)]
-            batches = _stack_batches([s[0] for s in samples])
-            w = np.stack([s[1] for s in samples])
-            state, metrics = multi_update(state, batches, jnp.asarray(w))
-            td = np.abs(np.asarray(metrics["td_error"])) + 1e-6
-            for i, (_, _, idx) in enumerate(samples):
-                service.update_priorities(idx, td[i])
-        else:
-            batches = _stack_batches(
-                [service.sample(cfg.batch_size) for _ in range(k)])
-            state, metrics = multi_update(state, batches)
-        # last step's scalars for logging
-        return {name: value[-1] for name, value in metrics.items()}
 
     def train_steps(n: int):
+        """n updates: pipelined K-chunks, then single-dispatch remainder."""
+        nonlocal state
         metrics = None
-        remaining = n
-        while remaining >= K and K > 1:
-            metrics = train_chunk(K)
-            remaining -= K
-        for _ in range(remaining):
+        n_chunks, remainder = (n // K, n % K) if K > 1 else (0, n)
+        if n_chunks:
+            if not cfg.async_actors:
+                # Sync mode just collected fresh episodes; drop a chunk
+                # sampled before them so every cycle trains on the newest
+                # distribution.
+                pipeline.invalidate()
+            state, metrics = pipeline.run(
+                state, n_chunks, on_chunk=_on_chunk,
+                final_prefetch=cfg.async_actors,
+            )
+        for _ in range(remainder):
             metrics = train_single()
-        return metrics
+        if metrics is None:
+            return None
+        # last step's scalars for logging (chunk metrics are stacked [K])
+        return {
+            name: (v if v.ndim == 0 else v[-1])
+            for name, v in metrics.items()
+            if name in ("critic_loss", "actor_loss", "q_mean")
+        }
 
     stop_actors = threading.Event()
     actor_threads: dict[int, threading.Thread] = {}
@@ -313,6 +366,7 @@ def train(cfg: ExperimentConfig) -> dict:
     last_metrics: dict = {}
     for epoch in range(cfg.n_epochs):
         for cycle in range(cfg.n_cycles):
+            cycle_t0 = time.monotonic()
             # collect (sync mode; async actors stream in the background)
             if not cfg.async_actors:
                 for actor in actors:
@@ -332,26 +386,42 @@ def train(cfg: ExperimentConfig) -> dict:
             else:
                 metrics = train_steps(cfg.train_steps_per_cycle)
             rate = timer.stop(cfg.train_steps_per_cycle)
+            # weight staleness actors saw this cycle, measured before the
+            # cycle-end publish (<= K in async mode, one cycle in sync mode)
+            weight_lag = lstep - weights.step
             publish()
-            # eval + log (main.py:309-353)
-            eval_metrics = evaluator.evaluate(cfg.eval_trials,
-                                              seed=cfg.seed + epoch * 1000 + cycle)
+            # eval + log (main.py:309-353). Concurrent mode: request a fresh
+            # eval against the just-published weights and log the most
+            # recent COMPLETED one; the learner thread never waits.
+            eval_seed = cfg.seed + epoch * 1000 + cycle
+            if async_eval is not None:
+                async_eval.request(cfg.eval_trials, seed=eval_seed)
+                eval_metrics = async_eval.latest()
+            else:
+                eval_metrics = evaluator.evaluate(cfg.eval_trials,
+                                                  seed=eval_seed)
             last_metrics = {
-                "avg_test_reward": eval_metrics["avg_test_reward"],
-                "ewma_test_reward": eval_metrics["ewma_test_reward"],
-                "success_rate": eval_metrics["success_rate"],
                 "critic_loss": float(jax.device_get(metrics["critic_loss"])),
                 "actor_loss": float(jax.device_get(metrics["actor_loss"])),
                 "env_steps": service.env_steps,
+                "weight_lag_steps": weight_lag,
             }
+            if eval_metrics is not None:
+                last_metrics.update({
+                    "avg_test_reward": eval_metrics["avg_test_reward"],
+                    "ewma_test_reward": eval_metrics["ewma_test_reward"],
+                    "success_rate": eval_metrics["success_rate"],
+                    "eval_lag_steps": lstep - eval_metrics["learner_step"],
+                })
             if rate is not None:
                 last_metrics["grad_steps_per_sec"] = round(rate, 2)
+            last_metrics["cycle_time_s"] = round(time.monotonic() - cycle_t0, 4)
             dead = service.dead_actors()
             if dead:
                 print(f"WARNING: actors missing heartbeats: {dead}", flush=True)
             if cfg.async_actors:
                 supervise_actors()
-            bus.log(int(jax.device_get(state.step)), last_metrics)
+            bus.log(lstep, last_metrics)
             if (cycle + 1) % cfg.checkpoint_every == 0:
                 ckpt.save(
                     state if mesh is None else jax.device_get(state),
@@ -360,6 +430,19 @@ def train(cfg: ExperimentConfig) -> dict:
     stop_actors.set()
     for t in actor_threads.values():
         t.join(timeout=10.0)
+    if async_eval is not None:
+        # Drain the last requested eval so the returned metrics reflect the
+        # final published weights, then log it.
+        final_eval = async_eval.wait()
+        async_eval.close()
+        if final_eval is not None:
+            last_metrics.update({
+                "avg_test_reward": final_eval["avg_test_reward"],
+                "ewma_test_reward": final_eval["ewma_test_reward"],
+                "success_rate": final_eval["success_rate"],
+                "eval_lag_steps": lstep - final_eval["learner_step"],
+            })
+            bus.log(lstep, last_metrics)
     ckpt.wait()
     bus.close()
     if receiver is not None:

@@ -212,3 +212,68 @@ def test_socket_transport_roundtrip():
     sender.close()
     recv.close()
     svc.close()
+
+
+class _ScaledSpyGoalEnv(FakeGoalEnv):
+    """FakeGoalEnv with a non-(-1,1) action box that records what it is
+    stepped with. Regression guard for VERDICT r1 #4: the round-1 goal actor
+    stepped raw tanh actions while the Evaluator rescaled."""
+
+    def __init__(self, scale: float, **kw):
+        super().__init__(**kw)
+        from d4pg_tpu.envs.fake import _Box
+
+        self.scale = scale
+        self.action_space = _Box(-scale, scale, (2,))
+        self.stepped_actions: list[np.ndarray] = []
+
+    def step(self, action):
+        self.stepped_actions.append(np.asarray(action, np.float32).copy())
+        return super().step(np.asarray(action, np.float32) / self.scale)
+
+
+def test_goal_actor_rescales_actions():
+    from d4pg_tpu.envs.wrappers import rescale_action
+
+    obs_dim = 2 + 2
+    config = D4PGConfig(obs_dim=obs_dim, act_dim=2, v_min=-50, v_max=0,
+                        n_atoms=11, hidden=(16, 16))
+    svc = ReplayService(ReplayBuffer(10_000, obs_dim, 2))
+    ws = WeightStore()
+    env = _ScaledSpyGoalEnv(scale=5.0, horizon=20, seed=3)
+    actor = GoalActorWorker("g0", config, ActorConfig(), env, svc, ws,
+                            her_ratio=0.0, rng_seed=4, seed=4)
+    T = actor.run_episode(max_steps=20)
+    svc.flush()
+    stepped = np.stack(env.stepped_actions)
+    stored = svc.buffer.gather(np.arange(T)).action
+    # env sees the affine-rescaled action, buffer keeps the tanh-space one
+    low = np.full(2, -5.0, np.float32)
+    high = np.full(2, 5.0, np.float32)
+    np.testing.assert_allclose(stepped, rescale_action(stored, low, high),
+                               rtol=1e-6, atol=1e-6)
+    assert np.abs(stepped).max() > 1.0  # actually left the tanh range
+    assert np.abs(stored).max() <= 1.0
+    svc.close()
+
+
+def test_async_evaluator_runs_off_thread():
+    from d4pg_tpu.distributed import AsyncEvaluator
+
+    config = D4PGConfig(obs_dim=4, act_dim=2, v_min=-5, v_max=0, n_atoms=11,
+                        hidden=(16, 16))
+    ws = WeightStore()
+    ev = Evaluator(config, lambda: PointMassEnv(horizon=10, seed=7), ws,
+                   max_steps=10)
+    state = init_state(config, jax.random.key(0))
+    ws.publish(state.actor_params, step=3)
+    aev = AsyncEvaluator(ev)
+    assert aev.latest() is None
+    assert aev.request(n_trials=2, seed=0)
+    got = aev.wait(timeout=60.0)
+    assert got is not None and got["learner_step"] == 3
+    assert np.isfinite(got["avg_test_reward"])
+    # latest() returns a copy, not a live reference
+    got["avg_test_reward"] = 1e9
+    assert aev.latest()["avg_test_reward"] != 1e9
+    aev.close()

@@ -141,12 +141,15 @@ def test_full_train_determinism(tmp_path):
     from d4pg_tpu.train import train
 
     def run(tag):
+        # concurrent_eval=False: with the background evaluator, WHICH cycle
+        # row an eval result lands in depends on thread timing; inline eval
+        # keeps the CSV bitwise-reproducible.
         cfg = ExperimentConfig(
             env="point", max_steps=20, num_envs=2, warmup=100, n_epochs=1,
             n_cycles=2, episodes_per_cycle=2, train_steps_per_cycle=4,
             eval_trials=2, batch_size=16, memory_size=2000,
             log_dir=str(tmp_path / tag), hidden=(16, 16), n_atoms=11,
-            v_min=-5.0, v_max=0.0, seed=123,
+            v_min=-5.0, v_max=0.0, seed=123, concurrent_eval=False,
         )
         train(cfg)
         csv = os.path.join(str(tmp_path / tag), cfg.run_name(), "returns.csv")

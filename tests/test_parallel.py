@@ -108,3 +108,63 @@ def test_sharded_update_multi_step_stability(rng):
     assert leaf.sharding.is_fully_replicated
     assert np.isfinite(float(metrics["critic_loss"]))
     assert int(state.step) == 3
+
+
+def test_sharded_multi_update_matches_sequential(rng):
+    """The production config (VERDICT r1 #3): K scanned updates sharded over
+    the data axis == K sequential sharded updates on the same batches."""
+    from d4pg_tpu.parallel import make_sharded_multi_update, shard_stacked
+
+    config = _config()
+    K = 4
+    batches = [_batch(rng) for _ in range(K)]
+    w = np.ones((B,), np.float32)
+
+    mesh = make_mesh(MeshSpec(data_parallel=4), devices=jax.devices()[:4])
+    seq_state = replicate_state(init_state(config, jax.random.key(7)), mesh)
+    seq_update = make_sharded_update(config, mesh, donate=False)
+    seq_tds = []
+    for b in batches:
+        seq_state, m = seq_update(seq_state, shard_batch(b, mesh),
+                                  shard_batch(jnp.asarray(w), mesh))
+        seq_tds.append(np.asarray(m["td_error"]))
+
+    stacked = TransitionBatch(*[np.stack(x) for x in zip(*batches)])
+    multi_state = replicate_state(init_state(config, jax.random.key(7)), mesh)
+    multi_update = make_sharded_multi_update(config, mesh, donate=False)
+    multi_state, ms = multi_update(
+        multi_state,
+        shard_stacked(stacked, mesh),
+        shard_stacked(jnp.ones((K, B), jnp.float32), mesh),
+    )
+
+    assert int(jax.device_get(multi_state.step)) == K
+    np.testing.assert_allclose(
+        np.asarray(ms["td_error"]), np.stack(seq_tds), rtol=1e-4, atol=1e-5
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(seq_state.critic_params),
+        jax.tree_util.tree_leaves(multi_state.critic_params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+    leaf = jax.tree_util.tree_leaves(multi_state.actor_params)[0]
+    assert leaf.sharding.is_fully_replicated
+
+
+def test_train_mesh_with_updates_per_dispatch(tmp_path):
+    """End-to-end train() on a 2-device data mesh WITH K>1 fused dispatch —
+    the round-1 degrade path is gone (VERDICT r1 #3)."""
+    from d4pg_tpu.config import ExperimentConfig
+    from d4pg_tpu.train import train
+
+    cfg = ExperimentConfig(
+        env="point", max_steps=20, num_envs=2, warmup=100, n_epochs=1,
+        n_cycles=2, episodes_per_cycle=1, train_steps_per_cycle=5,
+        eval_trials=1, batch_size=16, memory_size=2000,
+        log_dir=str(tmp_path), hidden=(16, 16), n_atoms=11,
+        v_min=-5.0, v_max=0.0, data_parallel=2, updates_per_dispatch=2,
+    )
+    metrics = train(cfg)
+    assert np.isfinite(metrics["critic_loss"])
+    assert "avg_test_reward" in metrics
