@@ -96,7 +96,11 @@ def bench_end_to_end(k: int = 16, capacity: int = 200_000,
                         compute_dtype="bfloat16")
     state = init_state(config, jax.random.key(0))
     update = make_multi_update(config, donate=True, use_is_weights=True)
-    buffer = PrioritizedReplayBuffer(capacity, OBS_DIM, ACT_DIM, alpha=0.6)
+    # shipped default (train.py 'auto'): ring in HBM on an accelerator,
+    # so a dispatch ships [K, B] indices instead of [K, B, 376] rows
+    storage = "device" if jax.default_backend() != "cpu" else "host"
+    buffer = PrioritizedReplayBuffer(capacity, OBS_DIM, ACT_DIM, alpha=0.6,
+                                     storage=storage)
     beta = LinearSchedule(100_000, 1.0, 0.4)
 
     rng = np.random.default_rng(0)
@@ -114,15 +118,9 @@ def bench_end_to_end(k: int = 16, capacity: int = 200_000,
 
     lstep = 0
 
-    def _stack(batches):
-        return TransitionBatch(*[np.stack(x) for x in zip(*batches)])
-
     def sample_chunk():
-        b = beta.value(lstep)
-        samples = [buffer.sample(BATCH, beta=b) for _ in range(k)]
-        return (_stack([s[0] for s in samples]),
-                np.stack([s[1] for s in samples]).astype(np.float32)), \
-               [s[2] for s in samples]
+        batches, w, idx = buffer.sample_chunk(k, BATCH, beta=beta.value(lstep))
+        return (batches, w), idx
 
     def write_back(idx_list, td):
         for i, idx in enumerate(idx_list):

@@ -41,12 +41,14 @@ def run_actor(
     weights_port: int,
     actor_id: str = "remote-0",
     max_ticks: int | None = None,
+    secret: str | None = None,
 ) -> int:
     cfg = cfg.resolve()
     obs_dim, act_dim, obs_dtype = infer_dims(cfg)
     config = cfg.learner_config(obs_dim, act_dim)
-    sender = TransitionSender(learner_host, transitions_port, actor_id=actor_id)
-    weights = WeightClient(learner_host, weights_port)
+    sender = TransitionSender(learner_host, transitions_port,
+                              actor_id=actor_id, secret=secret)
+    weights = WeightClient(learner_host, weights_port, secret=secret)
     pool = EnvPool(
         [make_env_fn(cfg, seed=cfg.seed + i) for i in range(cfg.num_envs)],
         seed=cfg.seed,
@@ -89,11 +91,14 @@ def main(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--noise", choices=("gaussian", "ou"), default="gaussian")
     p.add_argument("--max_ticks", type=int, default=None)
+    p.add_argument("--secret", default="",
+                   help="shared secret matching the learner's --serve_secret")
     ns = p.parse_args(argv)
     cfg = ExperimentConfig(env=ns.env, num_envs=ns.num_envs, n_steps=ns.n_steps,
                            seed=ns.seed, noise=ns.noise)
     steps = run_actor(cfg, ns.learner_host, ns.transitions_port,
-                      ns.weights_port, ns.actor_id, ns.max_ticks)
+                      ns.weights_port, ns.actor_id, ns.max_ticks,
+                      secret=ns.secret or None)
     print(f"collected {steps} env steps")
 
 

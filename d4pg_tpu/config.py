@@ -38,6 +38,10 @@ class ExperimentConfig:
     per_beta0: float = 0.4  # ddpg.py:84
     per_beta_steps: int = 100_000  # ddpg.py:85
     n_steps: int = 3  # --n_steps
+    # 'device': transition ring in accelerator HBM (host keeps PER trees,
+    # picks indices; per-dispatch H2D is O(indices) not O(batch bytes));
+    # 'auto' selects device on an accelerator single-device learner.
+    replay_storage: str = "auto"
     # K learner updates fused into one device dispatch via lax.scan
     # (~16x single-dispatch throughput at K=16 on one chip; PER priority
     # write-back then lags by <= 2K steps with the prefetch pipeline).
@@ -79,6 +83,8 @@ class ExperimentConfig:
     data_parallel: int = 1  # learner mesh data axis (1 = single device)
     async_actors: bool = False  # decoupled D4PG-paper actor/learner loop
     serve: bool = False  # accept remote actors (actor_main.py) over TCP
+    serve_host: str = "127.0.0.1"  # bind address; set the DCN iface for fleets
+    serve_secret: str = ""  # shared secret gating remote peers ('' = open)
     serve_transitions_port: int = 0  # 0 = ephemeral
     serve_weights_port: int = 0
     profile_dir: str = ""  # capture an XLA trace of the first cycle
@@ -163,6 +169,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--per_beta0", type=float, default=d.per_beta0)
     p.add_argument("--per_beta_steps", type=int, default=d.per_beta_steps)
     p.add_argument("--n_steps", type=int, default=d.n_steps)
+    p.add_argument("--replay_storage", choices=("auto", "host", "device"),
+                   default=d.replay_storage)
     p.add_argument("--updates_per_dispatch", type=int,
                    default=d.updates_per_dispatch)
     p.add_argument("--gamma", type=float, default=d.gamma)
@@ -196,6 +204,8 @@ def build_parser() -> argparse.ArgumentParser:
     _add_bool_flag(p, "async_actors", d.async_actors,
                    "decoupled actor/learner loop")
     _add_bool_flag(p, "serve", d.serve, "accept remote actors over TCP")
+    p.add_argument("--serve_host", default=d.serve_host)
+    p.add_argument("--serve_secret", default=d.serve_secret)
     p.add_argument("--serve_transitions_port", type=int,
                    default=d.serve_transitions_port)
     p.add_argument("--serve_weights_port", type=int, default=d.serve_weights_port)

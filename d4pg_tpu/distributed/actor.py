@@ -269,7 +269,10 @@ class GoalActorWorker(_BaseActor):
         )
         relabeled = relabeled._replace(
             reward=relabeled.reward * self.cfg.reward_scale)
-        self.service.add(relabeled, actor_id=self.actor_id)
+        # relabels are synthetic rows, not fresh env interaction: keep them
+        # out of the env_steps counter (it is logged and checkpointed)
+        self.service.add(relabeled, actor_id=self.actor_id,
+                         count_env_steps=False)
         self._reset_noise(np.array([True]))  # episode boundary: zero OU state
         self._decay_epsilon()
         return T

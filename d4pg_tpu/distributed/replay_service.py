@@ -48,16 +48,22 @@ class ReplayService:
 
     # -- actor-facing ------------------------------------------------------
     def add(self, batch: TransitionBatch, actor_id: str = "local",
-            block: bool = True, timeout: float | None = 5.0) -> bool:
+            block: bool = True, timeout: float | None = 5.0,
+            count_env_steps: bool = True) -> bool:
         """Enqueue transitions (backpressure via the bounded queue). Returns
-        False if the queue stayed full past ``timeout``."""
+        False if the queue stayed full past ``timeout``.
+
+        ``count_env_steps=False`` for rows that do not correspond to fresh
+        environment interaction (HER relabels) — otherwise the env_steps
+        counter inflates by (1 + her_ratio)x in HER runs."""
         self.heartbeat(actor_id)
         if batch.obs.shape[0] == 0:
             return True
         with self._lock:
             self._pending += 1
         try:
-            self._queue.put((actor_id, batch), block=block, timeout=timeout)
+            self._queue.put((actor_id, batch, count_env_steps),
+                            block=block, timeout=timeout)
             return True
         except queue.Full:
             with self._lock:
@@ -70,17 +76,40 @@ class ReplayService:
 
     # -- learner-facing ----------------------------------------------------
     def sample(self, batch_size: int, beta: float = 0.4):
-        """PER: (batch, weights, idx); uniform: batch. Mirrors the learner's
-        buffer-kind dispatch (``ddpg.py:187-197``)."""
+        """PER: (batch, weights, idx, generation); uniform: batch. Mirrors
+        the learner's buffer-kind dispatch (``ddpg.py:187-197``); the
+        generation snapshot guards the priority write-back against the
+        drain thread overwriting a sampled slot in flight."""
         with self._buffer_lock:
             if isinstance(self.buffer, PrioritizedReplayBuffer):
-                return self.buffer.sample(batch_size, beta=beta)
+                batch, w, idx = self.buffer.sample(batch_size, beta=beta)
+                return batch, w, idx, self.buffer.generation[idx].copy()
             return self.buffer.sample(batch_size)
 
-    def update_priorities(self, idx: np.ndarray, priorities: np.ndarray) -> None:
+    def sample_chunk(self, k: int, batch_size: int, beta: float = 0.4):
+        """K stacked batches in one storage gather: (batches [K, B, ...],
+        weights-or-None, idx [K, B], generation-or-None [K, B]) — the
+        K-updates-per-dispatch sample path (``learner/pipeline.py``). The
+        generation snapshot lets the deferred priority write-back skip
+        slots the drain thread overwrote in flight."""
+        with self._buffer_lock:
+            if isinstance(self.buffer, PrioritizedReplayBuffer):
+                batches, w, idx = self.buffer.sample_chunk(k, batch_size,
+                                                           beta=beta)
+                return batches, w, idx, self.buffer.generation[idx].copy()
+            batches, _, idx = self.buffer.sample_chunk(k, batch_size)
+            return batches, None, idx, None
+
+    def update_priorities(
+        self,
+        idx: np.ndarray,
+        priorities: np.ndarray,
+        generation: np.ndarray | None = None,
+    ) -> None:
         if isinstance(self.buffer, PrioritizedReplayBuffer):
             with self._buffer_lock:
-                self.buffer.update_priorities(idx, priorities)
+                self.buffer.update_priorities(idx, priorities,
+                                              generation=generation)
 
     @property
     def env_steps(self) -> int:
@@ -119,7 +148,7 @@ class ReplayService:
     def _drain(self) -> None:
         while not self._stop.is_set():
             try:
-                _, batch = self._queue.get(timeout=0.1)
+                _, batch, count = self._queue.get(timeout=0.1)
             except queue.Empty:
                 continue
             try:
@@ -127,7 +156,8 @@ class ReplayService:
                     self.buffer.add(batch)
             finally:
                 with self._lock:
-                    self._env_steps += batch.obs.shape[0]
+                    if count:
+                        self._env_steps += batch.obs.shape[0]
                     self._pending -= 1
 
     def flush(self, timeout: float = 5.0) -> None:

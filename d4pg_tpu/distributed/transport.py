@@ -12,11 +12,22 @@ payload = npz-serialized TransitionBatch (+ actor id). TCP gives ordering
 and backpressure for free; a slow learner applies backpressure through the
 kernel socket buffers and the sender's bounded queue. Heartbeats ride the
 same connection as empty batches.
+
+Hardening: servers bind loopback by default (pass the DCN interface
+explicitly for cross-host fleets), payload lengths are capped (the u32
+frame length is peer-controlled — without a cap any peer could make the
+receiver allocate 4 GiB), and an optional shared ``secret`` enables an
+HMAC-SHA256 challenge-response handshake on connect so only authorized
+actors can inject replay data (np.load is pickle-free, so the payloads
+themselves cannot execute code).
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import io
+import os
 import socket
 import struct
 import threading
@@ -28,6 +39,49 @@ from d4pg_tpu.replay.uniform import TransitionBatch
 
 _MAGIC = 0xD4F6
 _HEADER = struct.Struct("!II")
+_NONCE_LEN = 16
+_MAC_LEN = 32  # sha256 digest
+MAX_PAYLOAD = 64 << 20  # 64 MiB: far above any sane batch/param frame
+
+
+def _hs_mac(secret: str, nonce: bytes) -> bytes:
+    return hmac.new(secret.encode(), nonce, hashlib.sha256).digest()
+
+
+def server_handshake(conn: socket.socket, secret: Optional[str],
+                     timeout: float = 5.0) -> bool:
+    """Server side of the connect handshake: send a fresh nonce, require
+    HMAC-SHA256(secret, nonce) back. No-op (True) when no secret is set."""
+    if not secret:
+        return True
+    nonce = os.urandom(_NONCE_LEN)
+    prev = conn.gettimeout()
+    conn.settimeout(timeout)
+    try:
+        conn.sendall(nonce)
+        mac = _recv_exact(conn, _MAC_LEN)
+        return mac is not None and hmac.compare_digest(
+            mac, _hs_mac(secret, nonce))
+    except OSError:
+        return False
+    finally:
+        conn.settimeout(prev)
+
+
+def client_handshake(sock: socket.socket, secret: Optional[str],
+                     timeout: float = 5.0) -> None:
+    """Client side: answer the server's nonce challenge."""
+    if not secret:
+        return
+    prev = sock.gettimeout()
+    sock.settimeout(timeout)
+    try:
+        nonce = _recv_exact(sock, _NONCE_LEN)
+        if nonce is None:
+            raise ConnectionError("server closed during handshake")
+        sock.sendall(_hs_mac(secret, nonce))
+    finally:
+        sock.settimeout(prev)
 
 
 def _encode(actor_id: str, batch: TransitionBatch) -> bytes:
@@ -71,9 +125,10 @@ class TransitionSender:
     """Actor-side client: connects to the learner host and streams batches."""
 
     def __init__(self, host: str, port: int, actor_id: str = "remote",
-                 connect_timeout: float = 10.0):
+                 connect_timeout: float = 10.0, secret: Optional[str] = None):
         self.actor_id = actor_id
         self._sock = socket.create_connection((host, port), timeout=connect_timeout)
+        client_handshake(self._sock, secret)
         self._sock.settimeout(None)
         self._lock = threading.Lock()
 
@@ -97,10 +152,14 @@ class TransitionReceiver:
     def __init__(
         self,
         on_batch: Callable[[TransitionBatch, str], object],
-        host: str = "0.0.0.0",
+        host: str = "127.0.0.1",
         port: int = 0,
+        secret: Optional[str] = None,
+        max_payload: int = MAX_PAYLOAD,
     ):
         self._on_batch = on_batch
+        self._secret = secret
+        self._max_payload = int(max_payload)
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind((host, port))
@@ -126,13 +185,15 @@ class TransitionReceiver:
 
     def _serve(self, conn: socket.socket) -> None:
         with conn:
+            if not server_handshake(conn, self._secret):
+                return  # unauthenticated peer; drop before reading frames
             while not self._stop.is_set():
                 header = _recv_exact(conn, _HEADER.size)
                 if header is None:
                     return
                 magic, length = _HEADER.unpack(header)
-                if magic != _MAGIC:
-                    return  # corrupt stream; drop the connection
+                if magic != _MAGIC or length > self._max_payload:
+                    return  # corrupt or hostile stream; drop the connection
                 payload = _recv_exact(conn, length)
                 if payload is None:
                     return

@@ -43,14 +43,25 @@ def _unflatten(flat: dict[str, np.ndarray]):
     return unflatten_dict(dict(flat), sep="/")
 
 
-from d4pg_tpu.distributed.transport import _recv_exact
+from d4pg_tpu.distributed.transport import (
+    MAX_PAYLOAD,
+    _recv_exact,
+    client_handshake,
+    server_handshake,
+)
 
 
 class WeightServer:
-    """Serves a WeightStore's latest params to remote pullers."""
+    """Serves a WeightStore's latest params to remote pullers.
 
-    def __init__(self, store: WeightStore, host: str = "0.0.0.0", port: int = 0):
+    Binds loopback by default (pass the DCN interface for cross-host
+    fleets); optional shared ``secret`` gates pullers with the same
+    HMAC handshake as the transition plane."""
+
+    def __init__(self, store: WeightStore, host: str = "127.0.0.1",
+                 port: int = 0, secret: str | None = None):
         self._store = store
+        self._secret = secret
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind((host, port))
@@ -73,6 +84,8 @@ class WeightServer:
 
     def _serve(self, conn: socket.socket) -> None:
         with conn:
+            if not server_handshake(conn, self._secret):
+                return
             while not self._stop.is_set():
                 req = _recv_exact(conn, _REQ.size)
                 if req is None:
@@ -108,8 +121,10 @@ class WeightClient:
     """Actor-side puller mirroring the WeightStore reader interface, so a
     remote actor constructs its WeightStore-shaped view from the wire."""
 
-    def __init__(self, host: str, port: int, connect_timeout: float = 10.0):
+    def __init__(self, host: str, port: int, connect_timeout: float = 10.0,
+                 secret: str | None = None):
         self._sock = socket.create_connection((host, port), timeout=connect_timeout)
+        client_handshake(self._sock, secret)
         self._sock.settimeout(None)
         self._lock = threading.Lock()
         self.step = 0
@@ -121,7 +136,7 @@ class WeightClient:
             if head is None:
                 raise ConnectionError("weight server closed the connection")
             magic, length = _RESP.unpack(head)
-            if magic != _MAGIC:
+            if magic != _MAGIC or length > MAX_PAYLOAD:
                 raise ConnectionError("corrupt weight stream")
             if length == 0:
                 return None

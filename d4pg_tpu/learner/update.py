@@ -118,8 +118,15 @@ def update_step(
     )
     critic_params = optax.apply_updates(state.critic_params, critic_updates)
 
-    # --- actor step (through the *updated* critic, like the reference,
-    # which steps the critic optimizer before the policy loss) -------------
+    # --- actor step. Documented divergence: the policy loss here flows
+    # through the critic params the critic Adam step just produced. The
+    # reference computes it with its LOCAL critic, which at that point
+    # still predates the global optimizer step (``ddpg.py:236-249`` —
+    # ``sync_local_global`` pulls the stepped weights back only at
+    # ``ddpg.py:247``), i.e. the pre-update critic. Both are standard
+    # D4PG variants; one-step-fresher critic is the natural fit for a
+    # single fused XLA computation (like the (0.9, 0.999) Adam-b2 default,
+    # ``learner/state.py:34-41``). -----------------------------------------
     actor_loss, actor_grads = jax.value_and_grad(
         lambda p: _actor_loss_fn(config, p, critic_params, batch)
     )(state.actor_params)

@@ -1,0 +1,104 @@
+"""Device-resident replay storage: the transition ring lives in HBM.
+
+TPU-native redesign of the replay data path (no reference equivalent — the
+reference's buffers are per-process Python lists, ``replay_memory.py:14-19``,
+``prioritized_replay_memory.py:164-222``): host<->device bandwidth, not
+FLOPs, bounds a tunneled/PCIe-attached learner, and shipping every sampled
+batch from host RAM costs O(batch bytes) per dispatch (25MB/chunk at
+Humanoid sizes). With the ring in HBM the host keeps only the PER trees and
+picks INDICES; the device gathers rows locally:
+
+  - per-dispatch H2D drops to the [K, B] int32 index array (~16KB),
+  - inserts stream the actor batches once (they must cross anyway),
+  - the gathered chunk is already on device for the scanned update.
+
+Inserts are padded up to power-of-two buckets so XLA compiles a handful of
+scatter shapes instead of one per batch size; pad rows carry index ==
+capacity and are dropped by the scatter (``mode='drop'``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+from d4pg_tpu.replay.uniform import TransitionBatch
+
+
+def _bucket(n: int) -> int:
+    """Smallest power of two >= n (bounds the number of insert shapes XLA
+    compiles)."""
+    m = 1
+    while m < n:
+        m *= 2
+    return m
+
+
+class DeviceStore:
+    """Fixed-capacity transition storage on an accelerator device.
+
+    Same write/read interface as the host numpy storage inside
+    ``ReplayBuffer``; ``read`` accepts [B] or [K, B] index arrays and
+    returns device arrays (zero host copies).
+    """
+
+    def __init__(
+        self,
+        capacity: int,
+        obs_shape: tuple,
+        act_dim: int,
+        obs_dtype,
+        device=None,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        self.capacity = int(capacity)
+        storage = TransitionBatch(
+            obs=jnp.zeros((capacity, *obs_shape), obs_dtype),
+            action=jnp.zeros((capacity, act_dim), jnp.float32),
+            reward=jnp.zeros((capacity,), jnp.float32),
+            next_obs=jnp.zeros((capacity, *obs_shape), obs_dtype),
+            done=jnp.zeros((capacity,), jnp.float32),
+            discount=jnp.zeros((capacity,), jnp.float32),
+        )
+        self._storage = (
+            jax.device_put(storage, device) if device is not None else
+            jax.device_put(storage)
+        )
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def _insert(storage, idx, batch):
+            return TransitionBatch(*[
+                arr.at[idx].set(val.astype(arr.dtype), mode="drop")
+                for arr, val in zip(storage, batch)
+            ])
+
+        @jax.jit
+        def _gather(storage, idx):
+            return TransitionBatch(*[arr[idx] for arr in storage])
+
+        self._insert = _insert
+        self._gather = _gather
+
+    def write(self, idx: np.ndarray, batch: TransitionBatch) -> None:
+        n = len(idx)
+        m = _bucket(n)
+        if m != n:
+            pad = m - n
+            # pad index == capacity -> out of bounds -> dropped by the scatter
+            idx = np.concatenate(
+                [idx, np.full(pad, self.capacity, idx.dtype)])
+            batch = TransitionBatch(*[
+                np.concatenate([np.asarray(v),
+                                np.zeros((pad, *np.asarray(v).shape[1:]),
+                                         np.asarray(v).dtype)])
+                for v in batch
+            ])
+        self._storage = self._insert(
+            self._storage, np.asarray(idx, np.int32), batch)
+
+    def read(self, idx: np.ndarray) -> TransitionBatch:
+        """Gather rows on device; idx [B] or [K, B] (host or device ints)."""
+        return self._gather(self._storage, np.asarray(idx, np.int32))

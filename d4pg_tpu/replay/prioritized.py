@@ -77,17 +77,26 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         stratified: bool = True,
         backend: str = "auto",
         obs_dtype=None,
+        storage: str = "host",
+        device=None,
     ):
         super().__init__(capacity, obs_dim, act_dim, seed=seed,
-                         obs_dtype=obs_dtype)
+                         obs_dtype=obs_dtype, storage=storage, device=device)
         assert alpha >= 0
         self.alpha = float(alpha)
         self.stratified = bool(stratified)
         self._trees = _make_trees(self.capacity, backend)
         self.max_priority = 1.0
+        # Per-slot write generation: with async actors, a slot sampled by
+        # the learner can be overwritten by the drain thread before the TD
+        # error comes back; a generation captured at sample time lets
+        # update_priorities drop those writes instead of stamping a stale
+        # priority onto a brand-new transition.
+        self.generation = np.zeros(self.capacity, np.int64)
 
     def add(self, batch: TransitionBatch) -> np.ndarray:
         idx = super().add(batch)
+        self.generation[idx] += 1
         p = self.max_priority**self.alpha
         self._trees.set(idx, np.full(len(idx), p))
         return idx
@@ -122,8 +131,33 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         idx = self.sample_idx(batch_size)
         return self.gather(idx), self.is_weights(idx, beta), idx
 
-    def update_priorities(self, idx: np.ndarray, priorities: np.ndarray) -> None:
+    def sample_chunk(
+        self, k: int, batch_size: int, beta: float = 0.4
+    ) -> tuple[TransitionBatch, np.ndarray, np.ndarray]:
+        """K stacked proportional samples in ONE storage gather: (batches
+        [K, B, ...], weights [K, B], idx [K, B]). Tree walks and IS weights
+        stay on the host; with device storage only the idx array crosses."""
+        idx = np.stack([self.sample_idx(batch_size) for _ in range(k)])
+        w = np.stack([self.is_weights(idx[i], beta) for i in range(k)])
+        return self.gather(idx), w.astype(np.float32), idx
+
+    def update_priorities(
+        self,
+        idx: np.ndarray,
+        priorities: np.ndarray,
+        generation: np.ndarray | None = None,
+    ) -> None:
+        """Write ``priority ** alpha`` into the trees
+        (``prioritized_replay_memory.py:315-335``). When ``generation``
+        (captured at sample time) is given, entries whose slot has since
+        been overwritten are dropped."""
         priorities = np.asarray(priorities, np.float64)
         assert (priorities > 0).all(), "priorities must be positive"
+        if generation is not None:
+            live = self.generation[idx] == generation
+            if not live.all():
+                idx, priorities = idx[live], priorities[live]
+            if len(idx) == 0:
+                return
         self._trees.set(idx, priorities**self.alpha)
         self.max_priority = max(self.max_priority, float(priorities.max()))

@@ -36,12 +36,48 @@ class TransitionBatch(NamedTuple):
     discount: np.ndarray  # [B] float32 = gamma^m * (1 - done)
 
 
+class HostStore:
+    """Preallocated contiguous numpy storage (the default)."""
+
+    def __init__(self, capacity: int, obs_shape: tuple, act_dim: int, obs_dtype):
+        self.obs = np.zeros((capacity, *obs_shape), obs_dtype)
+        self.action = np.zeros((capacity, act_dim), np.float32)
+        self.reward = np.zeros((capacity,), np.float32)
+        self.next_obs = np.zeros((capacity, *obs_shape), obs_dtype)
+        self.done = np.zeros((capacity,), np.float32)
+        self.discount = np.zeros((capacity,), np.float32)
+
+    def write(self, idx: np.ndarray, batch: TransitionBatch) -> None:
+        self.obs[idx] = batch.obs
+        self.action[idx] = batch.action
+        self.reward[idx] = batch.reward
+        self.next_obs[idx] = batch.next_obs
+        self.done[idx] = batch.done
+        self.discount[idx] = batch.discount
+
+    def read(self, idx: np.ndarray) -> TransitionBatch:
+        return TransitionBatch(
+            obs=self.obs[idx],
+            action=self.action[idx],
+            reward=self.reward[idx],
+            next_obs=self.next_obs[idx],
+            done=self.done[idx],
+            discount=self.discount[idx],
+        )
+
+
 class ReplayBuffer:
-    """Fixed-capacity ring buffer over preallocated numpy storage.
+    """Fixed-capacity ring buffer over pluggable storage.
 
     ``obs_dim`` is an int for vector observations or a shape tuple for
     structured ones (e.g. ``(H, W, C)`` pixels, stored uint8 to keep a
     1M-frame buffer in host RAM; BASELINE.md config #4).
+
+    ``storage='host'`` (default) keeps numpy arrays in host RAM;
+    ``storage='device'`` keeps the ring in accelerator HBM
+    (``replay/device_ring.py``) — the host picks indices, the device
+    gathers rows, and per-dispatch host<->device traffic is O(indices)
+    instead of O(batch bytes).
     """
 
     def __init__(
@@ -51,17 +87,31 @@ class ReplayBuffer:
         act_dim: int,
         seed: int = 0,
         obs_dtype=None,
+        storage: str = "host",
+        device=None,
     ):
         self.capacity = int(capacity)
         obs_shape = (obs_dim,) if np.isscalar(obs_dim) else tuple(obs_dim)
         if obs_dtype is None:
             obs_dtype = np.float32 if len(obs_shape) == 1 else np.uint8
-        self.obs = np.zeros((capacity, *obs_shape), obs_dtype)
-        self.action = np.zeros((capacity, act_dim), np.float32)
-        self.reward = np.zeros((capacity,), np.float32)
-        self.next_obs = np.zeros((capacity, *obs_shape), obs_dtype)
-        self.done = np.zeros((capacity,), np.float32)
-        self.discount = np.zeros((capacity,), np.float32)
+        if storage == "device":
+            from d4pg_tpu.replay.device_ring import DeviceStore
+
+            self._store = DeviceStore(self.capacity, obs_shape, act_dim,
+                                      obs_dtype, device=device)
+        elif storage == "host":
+            self._store = HostStore(self.capacity, obs_shape, act_dim,
+                                    obs_dtype)
+            # direct-array aliases (tests, offline analysis)
+            self.obs = self._store.obs
+            self.action = self._store.action
+            self.reward = self._store.reward
+            self.next_obs = self._store.next_obs
+            self.done = self._store.done
+            self.discount = self._store.discount
+        else:
+            raise ValueError(f"unknown storage {storage!r}")
+        self.storage = storage
         self.size = 0
         self.head = 0
         self._rng = np.random.default_rng(seed)
@@ -75,28 +125,27 @@ class ReplayBuffer:
         if n > self.capacity:
             raise ValueError(f"batch of {n} exceeds capacity {self.capacity}")
         idx = (self.head + np.arange(n)) % self.capacity
-        self.obs[idx] = batch.obs
-        self.action[idx] = batch.action
-        self.reward[idx] = batch.reward
-        self.next_obs[idx] = batch.next_obs
-        self.done[idx] = batch.done
-        self.discount[idx] = batch.discount
+        self._store.write(idx, batch)
         self.head = int((self.head + n) % self.capacity)
         self.size = int(min(self.size + n, self.capacity))
         return idx
 
     def gather(self, idx: np.ndarray) -> TransitionBatch:
-        return TransitionBatch(
-            obs=self.obs[idx],
-            action=self.action[idx],
-            reward=self.reward[idx],
-            next_obs=self.next_obs[idx],
-            done=self.done[idx],
-            discount=self.discount[idx],
-        )
+        """Rows at ``idx`` ([B] or stacked [K, B]); device storage returns
+        device arrays without a host round trip."""
+        return self._store.read(idx)
 
     def sample(self, batch_size: int, replace: bool = True) -> TransitionBatch:
         if self.size == 0:
             raise ValueError("cannot sample from an empty buffer")
         idx = self._rng.choice(self.size, size=batch_size, replace=replace)
         return self.gather(idx)
+
+    def sample_chunk(self, k: int, batch_size: int):
+        """K stacked batches in ONE storage gather: (batches [K, B, ...],
+        None, idx [K, B]). Feeds the K-updates-per-dispatch learner path;
+        with device storage the rows never touch the host."""
+        if self.size == 0:
+            raise ValueError("cannot sample from an empty buffer")
+        idx = self._rng.choice(self.size, size=(k, batch_size), replace=True)
+        return self.gather(idx), None, idx

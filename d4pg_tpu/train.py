@@ -123,13 +123,38 @@ def train(cfg: ExperimentConfig) -> dict:
                              use_is_weights=cfg.prioritized_replay)
 
     # --- replay + schedule ------------------------------------------------
+    storage = cfg.replay_storage
+    if storage == "auto":
+        # Device-resident ring (replay/device_ring.py) when an accelerator
+        # is attached: per-dispatch H2D drops from O(batch bytes) to
+        # O(indices). Mesh path keeps rows on host (storage lives on one
+        # device); fall back when the ring wouldn't fit comfortably in HBM.
+        obs_elems = int(np.prod(obs_dim)) if not np.isscalar(obs_dim) else obs_dim
+        ring_bytes = cfg.memory_size * (
+            2 * obs_elems * np.dtype(obs_dtype).itemsize + (act_dim + 3) * 4)
+        storage = (
+            "device"
+            if jax.default_backend() != "cpu" and cfg.data_parallel == 1
+            and ring_bytes < 8e9
+            else "host"
+        )
+    elif storage == "device" and cfg.data_parallel > 1:
+        # The ring lives on ONE device; a sharded learner would re-pay the
+        # O(batch bytes) cross-device copy every dispatch (and fail outright
+        # on a multi-host mesh). Refuse instead of silently inverting the
+        # optimization.
+        raise ValueError(
+            "--replay_storage device is incompatible with --data_parallel > 1; "
+            "use 'host' (or 'auto', which resolves this automatically)")
     if cfg.prioritized_replay:
         buffer = PrioritizedReplayBuffer(cfg.memory_size, obs_dim, act_dim,
                                          alpha=cfg.per_alpha, seed=cfg.seed,
-                                         obs_dtype=obs_dtype)
+                                         obs_dtype=obs_dtype, storage=storage)
     else:
         buffer = ReplayBuffer(cfg.memory_size, obs_dim, act_dim, seed=cfg.seed,
-                              obs_dtype=obs_dtype)
+                              obs_dtype=obs_dtype, storage=storage)
+    if cfg.debug:
+        print(f"replay storage: {storage}", flush=True)
     beta = LinearSchedule(cfg.per_beta_steps, 1.0, cfg.per_beta0)
     service = ReplayService(buffer)
 
@@ -207,9 +232,13 @@ def train(cfg: ExperimentConfig) -> dict:
 
         receiver = TransitionReceiver(
             lambda b, aid: service.add(b, actor_id=aid),
+            host=cfg.serve_host,
             port=cfg.serve_transitions_port,
+            secret=cfg.serve_secret or None,
         )
-        weight_server = WeightServer(weights, port=cfg.serve_weights_port)
+        weight_server = WeightServer(weights, host=cfg.serve_host,
+                                     port=cfg.serve_weights_port,
+                                     secret=cfg.serve_secret or None)
         print(f"serving: transitions :{receiver.port} weights :{weight_server.port}",
               flush=True)
 
@@ -242,19 +271,14 @@ def train(cfg: ExperimentConfig) -> dict:
         NamedSharding(mesh, P(None, DATA_AXIS)) if mesh is not None else None
     )
 
-    def _stack_batches(batches):
-        return TransitionBatch(*[np.stack(x) for x in zip(*batches)])
-
     def _sample_chunk():
-        """Host-side sample of one K-chunk; returns (device payload, idx aux)."""
+        """One K-chunk: host tree walks pick [K, B] indices, ONE storage
+        gather fetches the rows (device storage: rows stay in HBM)."""
         if cfg.prioritized_replay:
-            b = beta.value(lstep)
-            samples = [service.sample(cfg.batch_size, beta=b) for _ in range(K)]
-            batches = _stack_batches([s[0] for s in samples])
-            w = np.stack([s[1] for s in samples]).astype(np.float32)
-            return (batches, w), [s[2] for s in samples]
-        batches = _stack_batches(
-            [service.sample(cfg.batch_size) for _ in range(K)])
+            batches, w, idx, gen = service.sample_chunk(
+                K, cfg.batch_size, beta=beta.value(lstep))
+            return (batches, w), (idx, gen)
+        batches, _, _, _ = service.sample_chunk(K, cfg.batch_size)
         return (batches, None), None
 
     # Double-buffered host->device staging (SURVEY.md §7 "hard parts"):
@@ -262,9 +286,10 @@ def train(cfg: ExperimentConfig) -> dict:
     # device_puts chunk t+1; PER priority staleness is bounded by 2K steps.
     # The pipeline itself lives in learner/pipeline.py, shared with bench.py
     # so the benchmarked loop IS the shipped loop.
-    def _per_write_back(idx_list, td):
-        for i, idx in enumerate(idx_list):
-            service.update_priorities(idx, td[i])
+    def _per_write_back(aux, td):
+        idx, gen = aux
+        for i in range(len(idx)):
+            service.update_priorities(idx[i], td[i], generation=gen[i])
 
     pipeline = (
         ChunkPipeline(
@@ -285,15 +310,16 @@ def train(cfg: ExperimentConfig) -> dict:
     def train_single():
         nonlocal state, lstep
         if cfg.prioritized_replay:
-            batch, w, idx = service.sample(cfg.batch_size,
-                                           beta=beta.value(lstep))
+            batch, w, idx, gen = service.sample(cfg.batch_size,
+                                                beta=beta.value(lstep))
             if mesh is not None:
                 batch = shard_batch(batch, mesh)
                 w = shard_batch(jnp.asarray(w), mesh)
             state, metrics = update(state, batch, jnp.asarray(w))
             lstep += 1
             service.update_priorities(
-                idx, np.abs(np.asarray(metrics["td_error"])) + 1e-6)
+                idx, np.abs(np.asarray(metrics["td_error"])) + 1e-6,
+                generation=gen)
         else:
             batch = service.sample(cfg.batch_size)
             if mesh is not None:

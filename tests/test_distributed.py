@@ -63,9 +63,9 @@ def test_replay_service_per_dispatch():
     svc = ReplayService(PrioritizedReplayBuffer(100, 4, 2))
     svc.add(_batch(8))
     svc.flush()
-    batch, w, idx = svc.sample(4, beta=0.5)
-    assert w.shape == (4,) and idx.shape == (4,)
-    svc.update_priorities(idx, np.full(4, 2.0))
+    batch, w, idx, gen = svc.sample(4, beta=0.5)
+    assert w.shape == (4,) and idx.shape == (4,) and gen.shape == (4,)
+    svc.update_priorities(idx, np.full(4, 2.0), generation=gen)
     svc.close()
 
 
@@ -277,3 +277,81 @@ def test_async_evaluator_runs_off_thread():
     got["avg_test_reward"] = 1e9
     assert aev.latest()["avg_test_reward"] != 1e9
     aev.close()
+
+
+def test_her_relabels_do_not_inflate_env_steps():
+    """env_steps counts fresh interaction only; HER relabels are synthetic
+    (ADVICE r1: drain counted both, inflating by (1+her_ratio)x)."""
+    obs_dim = 2 + 2
+    config = D4PGConfig(obs_dim=obs_dim, act_dim=2, v_min=-50, v_max=0,
+                        n_atoms=11, hidden=(16, 16))
+    svc = ReplayService(ReplayBuffer(10_000, obs_dim, 2))
+    ws = WeightStore()
+    env = FakeGoalEnv(horizon=30, seed=0)
+    actor = GoalActorWorker("g0", config, ActorConfig(gamma=0.98), env, svc, ws,
+                            her_ratio=1.0, rng_seed=2)
+    T = actor.run_episode(max_steps=30)
+    svc.flush()
+    assert len(svc) == 2 * T  # both row kinds stored...
+    assert svc.env_steps == T  # ...but only real steps counted
+    svc.close()
+
+
+def test_transport_rejects_wrong_secret_and_oversized_frames():
+    import socket
+    import struct
+    import time as _time
+
+    svc = ReplayService(ReplayBuffer(1000, 4, 2))
+    recv = TransitionReceiver(lambda b, aid: svc.add(b, actor_id=aid),
+                              host="127.0.0.1", secret="sesame",
+                              max_payload=1 << 20)
+    # right secret: frames land
+    good = TransitionSender("127.0.0.1", recv.port, actor_id="ok",
+                            secret="sesame")
+    good.send(_batch(4))
+    deadline = _time.monotonic() + 5
+    while len(svc) < 4 and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    assert len(svc) == 4
+    good.close()
+    # wrong secret: the server drops the connection before reading frames
+    bad = TransitionSender("127.0.0.1", recv.port, actor_id="evil",
+                           secret="wrong")
+    try:
+        for _ in range(50):
+            bad.send(_batch(4))
+            _time.sleep(0.005)
+    except OSError:
+        pass  # broken pipe once the server hangs up
+    finally:
+        bad.close()
+    assert len(svc) == 4  # nothing new landed
+    # authenticated peer claiming an absurd frame length is dropped too
+    sock = socket.create_connection(("127.0.0.1", recv.port))
+    from d4pg_tpu.distributed.transport import client_handshake
+    client_handshake(sock, "sesame")
+    sock.sendall(struct.pack("!II", 0xD4F6, 1 << 30))
+    _time.sleep(0.2)
+    sock.close()
+    assert len(svc) == 4
+    recv.close()
+    svc.close()
+
+
+def test_weight_plane_secret():
+    from d4pg_tpu.distributed.weight_server import WeightClient, WeightServer
+
+    ws = WeightStore()
+    ws.publish({"w": np.arange(4.0)}, step=1)
+    server = WeightServer(ws, host="127.0.0.1", secret="sesame")
+    client = WeightClient("127.0.0.1", server.port, secret="sesame")
+    version, params = client.get_if_newer(0)
+    assert version == 1
+    np.testing.assert_array_equal(params["w"], np.arange(4.0))
+    client.close()
+    bad = WeightClient("127.0.0.1", server.port, secret="nope")
+    with pytest.raises(ConnectionError):
+        bad.get_if_newer(0)
+    bad.close()
+    server.close()

@@ -276,3 +276,36 @@ def test_nstep_reset_drops_pending_windows():
     assert out.obs.shape[0] == 1
     assert out.obs[0, 0] == pytest.approx(10.0)  # post-reset head, not 1.0
     assert out.reward[0] == pytest.approx(10.0 + 0.9 * 20.0 + 0.81 * 30.0)
+
+
+def test_per_generation_guard_drops_stale_priority_updates():
+    """ADVICE r1: a slot overwritten between sample and write-back must not
+    receive the old transition's priority."""
+    from d4pg_tpu.replay import PrioritizedReplayBuffer
+    from d4pg_tpu.replay.uniform import TransitionBatch
+
+    def batch(n, val):
+        return TransitionBatch(
+            obs=np.full((n, 2), val, np.float32),
+            action=np.zeros((n, 1), np.float32),
+            reward=np.zeros(n, np.float32),
+            next_obs=np.zeros((n, 2), np.float32),
+            done=np.zeros(n, np.float32),
+            discount=np.full(n, 0.99, np.float32),
+        )
+
+    buf = PrioritizedReplayBuffer(8, 2, 1, alpha=1.0)
+    idx0 = buf.add(batch(8, 0.0))
+    gen = buf.generation[idx0].copy()
+    # ring wraps: slots 0..3 now hold NEW transitions
+    buf.add(batch(4, 1.0))
+    before = buf._trees.get(np.arange(8)).copy()
+    buf.update_priorities(idx0, np.full(8, 100.0), generation=gen)
+    after = buf._trees.get(np.arange(8))
+    # overwritten slots 0..3 kept their fresh-insert priority...
+    np.testing.assert_array_equal(after[:4], before[:4])
+    # ...surviving slots 4..7 got the new priority
+    np.testing.assert_allclose(after[4:], 100.0)
+    # without a generation, all update (legacy semantics)
+    buf.update_priorities(np.arange(4), np.full(4, 7.0))
+    np.testing.assert_allclose(buf._trees.get(np.arange(4)), 7.0)
