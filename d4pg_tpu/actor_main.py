@@ -28,8 +28,12 @@ class RemoteReplayClient:
         self._sender = sender
 
     def add(self, batch: TransitionBatch, actor_id: str = "remote",
-            block: bool = True, timeout: float | None = None) -> bool:
-        del actor_id, block, timeout  # TCP provides ordering + backpressure
+            block: bool = True, timeout: float | None = None,
+            count_env_steps: bool = True) -> bool:
+        # TCP provides ordering + backpressure. count_env_steps does not
+        # cross the wire: the learner counts every remote row as an env
+        # step (remote HER actors would need a frame flag — not wired).
+        del actor_id, block, timeout, count_env_steps
         self._sender.send(batch)
         return True
 
@@ -77,6 +81,32 @@ def run_actor(
         weights.close()
         pool.close()
     return actor.env_steps
+
+
+def run_local_actor_process(
+    cfg: ExperimentConfig,
+    learner_host: str,
+    transitions_port: int,
+    weights_port: int,
+    actor_id: str,
+    secret: str | None = None,
+) -> None:
+    """Entry point for locally SPAWNED actor processes (``train.py
+    --actor_procs N`` — the proper replacement for the reference's
+    ``mp.Process`` fan-out, ``main.py:399-405``, which shared memory and
+    the GIL-free illusion; these are real processes talking TCP).
+
+    Forces the CPU backend first: the accelerator belongs to the learner
+    process, and actor inference on these MLPs is host-friendly.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        run_actor(cfg, learner_host, transitions_port, weights_port,
+                  actor_id=actor_id, secret=secret)
+    except KeyboardInterrupt:
+        pass
 
 
 def main(argv=None):

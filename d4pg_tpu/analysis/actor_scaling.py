@@ -1,0 +1,88 @@
+"""Measure local actor-process scaling: env-steps/sec vs --actor_procs.
+
+The reference scales acting by forking N full worker processes sharing one
+model in OS shared memory (``main.py:399-405``); here N spawned actor
+processes stream transitions to the learner's TCP plane
+(``train.py --actor_procs``). This tool boots ONLY the ingest plane (replay
+service + transition receiver + weight server, no learner) and counts
+arriving env steps over a fixed window:
+
+    python -m d4pg_tpu.analysis.actor_scaling --procs 1 2 4 --seconds 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import multiprocessing as mp
+import time
+
+
+def measure(n_procs: int, seconds: float, env: str = "point",
+            num_envs: int = 8, max_steps: int = 200) -> float:
+    from d4pg_tpu.actor_main import run_local_actor_process
+    from d4pg_tpu.config import ExperimentConfig
+    from d4pg_tpu.distributed import ReplayService, WeightStore
+    from d4pg_tpu.distributed.transport import TransitionReceiver
+    from d4pg_tpu.distributed.weight_server import WeightServer
+    from d4pg_tpu.replay import ReplayBuffer
+    from d4pg_tpu.train import infer_dims
+
+    cfg = ExperimentConfig(env=env, num_envs=num_envs, max_steps=max_steps,
+                           v_min=-5.0, v_max=0.0)
+    obs_dim, act_dim, obs_dtype = infer_dims(cfg)
+    service = ReplayService(
+        ReplayBuffer(1_000_000, obs_dim, act_dim, obs_dtype=obs_dtype))
+    weights = WeightStore()
+    receiver = TransitionReceiver(
+        lambda b, aid: service.add(b, actor_id=aid), host="127.0.0.1")
+    weight_server = WeightServer(weights, host="127.0.0.1")
+
+    ctx = mp.get_context("spawn")
+    procs = []
+    for i in range(n_procs):
+        p = ctx.Process(
+            target=run_local_actor_process,
+            args=(dataclasses.replace(cfg, seed=1000 * (i + 1)), "127.0.0.1",
+                  receiver.port, weight_server.port, f"scale-{i}", None),
+            daemon=True,
+        )
+        p.start()
+        procs.append(p)
+
+    # let the fleet finish jax/env startup before the measurement window
+    deadline = time.monotonic() + 120.0
+    while service.env_steps < n_procs * num_envs and time.monotonic() < deadline:
+        time.sleep(0.1)
+    start_steps = service.env_steps
+    t0 = time.monotonic()
+    time.sleep(seconds)
+    rate = (service.env_steps - start_steps) / (time.monotonic() - t0)
+
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        p.join(timeout=5.0)
+    receiver.close()
+    weight_server.close()
+    service.close()
+    return rate
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="d4pg_tpu.analysis.actor_scaling")
+    ap.add_argument("--procs", type=int, nargs="+", default=[1, 2, 4])
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--env", default="point")
+    ap.add_argument("--num_envs", type=int, default=8)
+    ns = ap.parse_args(argv)
+    print(f"{'procs':>6} {'env-steps/sec':>14}")
+    base = None
+    for n in ns.procs:
+        rate = measure(n, ns.seconds, env=ns.env, num_envs=ns.num_envs)
+        base = base or rate
+        print(f"{n:>6} {rate:>14.0f}   ({rate / base:.2f}x)")
+
+
+if __name__ == "__main__":
+    main()

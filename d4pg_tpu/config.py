@@ -79,7 +79,11 @@ class ExperimentConfig:
     # process, main.py:395-397); 0 = inline on the learner thread.
     concurrent_eval: bool = True
     # distributed
-    n_workers: int = 1  # --n_workers (actor count)
+    n_workers: int = 1  # --n_workers (in-process actor threads)
+    # Spawned local actor PROCESSES connecting through the TCP plane
+    # (implies --serve): real parallelism for host-bound env stepping,
+    # unlike in-process actor threads which share the learner's GIL.
+    actor_procs: int = 0
     data_parallel: int = 1  # learner mesh data axis (1 = single device)
     async_actors: bool = False  # decoupled D4PG-paper actor/learner loop
     serve: bool = False  # accept remote actors (actor_main.py) over TCP
@@ -94,6 +98,12 @@ class ExperimentConfig:
     checkpoint_every: int = 1  # cycles between checkpoints (main.py:367)
     resume: bool = False
     debug: bool = False  # --debug
+    # One-flag parity mode: the reference's own hyperparameters — v_min/
+    # v_max from its per-env hook (main.py:84-99), Adam betas (0.9, 0.9)
+    # (shared_adam.py:4), lr 1e-3 for both nets (main.py:384-385,
+    # n_workers=1), no reward scaling, and single-dispatch updates (exact
+    # per-step priority write-back like ddpg.py:252-255).
+    strict_reference: bool = False
 
     def run_name(self) -> str:
         """Config-encoded run dir (parity: ``main.py:59-64``)."""
@@ -106,15 +116,26 @@ class ExperimentConfig:
 
     def resolve(self) -> "ExperimentConfig":
         """Fill v_min/v_max (+ reward scale / horizon) from the env preset
-        when unset (the ``configure_env_params`` hook, ``main.py:84-99``)."""
-        preset = get_preset(self.env)
-        updates = {}
+        when unset (the ``configure_env_params`` hook, ``main.py:84-99``).
+        ``strict_reference`` switches to the reference's own preset values
+        and training hyperparameters wholesale."""
+        preset = get_preset(self.env, strict=self.strict_reference)
+        updates: dict = {}
         if self.v_min is None:
             updates["v_min"] = preset.v_min
         if self.v_max is None:
             updates["v_max"] = preset.v_max
         if self.reward_scale == 1.0 and preset.reward_scale != 1.0:
             updates["reward_scale"] = preset.reward_scale
+        if self.strict_reference:
+            updates.update(
+                reward_scale=1.0,
+                lr_actor=1e-3,  # main.py:384-385 at n_workers=1
+                lr_critic=1e-3,
+                adam_b1=0.9,  # shared_adam.py:4
+                adam_b2=0.9,
+                updates_per_dispatch=1,  # per-step write-back, ddpg.py:252-255
+            )
         return dataclasses.replace(self, **updates) if updates else self
 
     def learner_config(self, obs_dim: int | tuple, act_dim: int) -> D4PGConfig:
@@ -200,6 +221,7 @@ def build_parser() -> argparse.ArgumentParser:
     _add_bool_flag(p, "concurrent_eval", d.concurrent_eval,
                    "evaluate on a background thread")
     p.add_argument("--n_workers", type=int, default=d.n_workers)
+    p.add_argument("--actor_procs", type=int, default=d.actor_procs)
     p.add_argument("--data_parallel", type=int, default=d.data_parallel)
     _add_bool_flag(p, "async_actors", d.async_actors,
                    "decoupled actor/learner loop")
@@ -215,6 +237,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--reward_scale", type=float, default=d.reward_scale)
     _add_bool_flag(p, "resume", d.resume, "resume from latest checkpoint")
     _add_bool_flag(p, "debug", d.debug, "debug logging")
+    _add_bool_flag(p, "strict_reference", d.strict_reference,
+                   "reference hyperparameter parity mode")
     return p
 
 
@@ -227,4 +251,5 @@ def parse_args(argv=None) -> ExperimentConfig:
     ns["async_actors"] = bool(ns["async_actors"])
     ns["serve"] = bool(ns["serve"])
     ns["concurrent_eval"] = bool(ns["concurrent_eval"])
+    ns["strict_reference"] = bool(ns["strict_reference"])
     return ExperimentConfig(**ns)

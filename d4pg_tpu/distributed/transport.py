@@ -184,21 +184,24 @@ class TransitionReceiver:
             self._threads.append(t)
 
     def _serve(self, conn: socket.socket) -> None:
-        with conn:
-            if not server_handshake(conn, self._secret):
-                return  # unauthenticated peer; drop before reading frames
-            while not self._stop.is_set():
-                header = _recv_exact(conn, _HEADER.size)
-                if header is None:
-                    return
-                magic, length = _HEADER.unpack(header)
-                if magic != _MAGIC or length > self._max_payload:
-                    return  # corrupt or hostile stream; drop the connection
-                payload = _recv_exact(conn, length)
-                if payload is None:
-                    return
-                actor_id, batch = _decode(payload)
-                self._on_batch(batch, actor_id)
+        try:
+            with conn:
+                if not server_handshake(conn, self._secret):
+                    return  # unauthenticated peer; drop before reading frames
+                while not self._stop.is_set():
+                    header = _recv_exact(conn, _HEADER.size)
+                    if header is None:
+                        return
+                    magic, length = _HEADER.unpack(header)
+                    if magic != _MAGIC or length > self._max_payload:
+                        return  # corrupt or hostile stream; drop the connection
+                    payload = _recv_exact(conn, length)
+                    if payload is None:
+                        return
+                    actor_id, batch = _decode(payload)
+                    self._on_batch(batch, actor_id)
+        except OSError:
+            return  # peer died mid-frame (actor killed); just drop it
 
     def close(self) -> None:
         self._stop.set()

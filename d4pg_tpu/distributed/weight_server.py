@@ -83,31 +83,37 @@ class WeightServer:
             threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
 
     def _serve(self, conn: socket.socket) -> None:
-        with conn:
-            if not server_handshake(conn, self._secret):
-                return
-            while not self._stop.is_set():
-                req = _recv_exact(conn, _REQ.size)
-                if req is None:
+        try:
+            with conn:
+                if not server_handshake(conn, self._secret):
                     return
-                magic, have = _REQ.unpack(req)
-                if magic != _MAGIC:
-                    return
-                got = self._store.get_if_newer(have)
-                if got is None:
-                    conn.sendall(_RESP.pack(_MAGIC, 0))
-                    continue
-                version, params = got
-                buf = io.BytesIO()
-                flat = _flatten(params)
-                np.savez(
-                    buf,
-                    __version__=np.int64(version),
-                    __step__=np.int64(self._store.step),
-                    **flat,
-                )
-                payload = buf.getvalue()
-                conn.sendall(_RESP.pack(_MAGIC, len(payload)) + payload)
+                while not self._stop.is_set():
+                    req = _recv_exact(conn, _REQ.size)
+                    if req is None:
+                        return
+                    magic, have = _REQ.unpack(req)
+                    if magic != _MAGIC:
+                        return
+                    # snapshot() reads (version, params, step) under one
+                    # lock: a publish landing between separate reads would
+                    # stamp step-N params with a newer step, corrupting the
+                    # client's staleness accounting.
+                    version, params, step = self._store.snapshot()
+                    if params is None or version <= have:
+                        conn.sendall(_RESP.pack(_MAGIC, 0))
+                        continue
+                    buf = io.BytesIO()
+                    flat = _flatten(params)
+                    np.savez(
+                        buf,
+                        __version__=np.int64(version),
+                        __step__=np.int64(step),
+                        **flat,
+                    )
+                    payload = buf.getvalue()
+                    conn.sendall(_RESP.pack(_MAGIC, len(payload)) + payload)
+        except OSError:
+            return  # peer died mid-frame (actor terminated); drop it
 
     def close(self) -> None:
         self._stop.set()

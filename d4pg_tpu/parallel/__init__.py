@@ -17,6 +17,7 @@ from d4pg_tpu.parallel.data_parallel import (
     replicate_state,
     shard_batch,
     shard_stacked,
+    stacked_sharding,
 )
 
 __all__ = [
@@ -27,4 +28,5 @@ __all__ = [
     "replicate_state",
     "shard_batch",
     "shard_stacked",
+    "stacked_sharding",
 ]

@@ -34,8 +34,11 @@ def _batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
-def _stacked_sharding(mesh: Mesh) -> NamedSharding:
-    """[K, B, ...] stacks: K replicated (scan axis), B split over ``data``."""
+def stacked_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for [K, B, ...] chunk stacks: K replicated (the scan axis),
+    B split over ``data``. The single source of truth for the stacked
+    layout — used by ``make_sharded_multi_update`` and by the training
+    loop's chunk staging."""
     return NamedSharding(mesh, P(None, DATA_AXIS))
 
 
@@ -54,7 +57,7 @@ def shard_stacked(batches, mesh: Mesh):
     """Shard a [K, B, ...] stack of batches: the scan axis K stays
     replicated, B splits over ``data``. Works on any pytree whose leaves
     carry the [K, B, ...] layout (TransitionBatch stacks, weight stacks)."""
-    return jax.device_put(batches, _stacked_sharding(mesh))
+    return jax.device_put(batches, stacked_sharding(mesh))
 
 
 def make_sharded_update(
@@ -112,7 +115,7 @@ def make_sharded_multi_update(
     replicated, ``td_error`` [K, B] sharded ``P(None, 'data')``.
     """
     repl = _replicated(mesh)
-    stacked = _stacked_sharding(mesh)
+    stacked = stacked_sharding(mesh)
     out_metrics = {
         "critic_loss": repl,
         "actor_loss": repl,

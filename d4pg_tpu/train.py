@@ -16,6 +16,7 @@ with per-cycle eval, TensorBoard logging and checkpointing
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import threading
 import time
@@ -24,7 +25,6 @@ import traceback
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from d4pg_tpu.config import ExperimentConfig, parse_args
 from d4pg_tpu.distributed import (
@@ -54,8 +54,8 @@ from d4pg_tpu.parallel import (
     make_sharded_update,
     replicate_state,
     shard_batch,
+    stacked_sharding,
 )
-from d4pg_tpu.parallel.mesh import DATA_AXIS
 from d4pg_tpu.replay import LinearSchedule, PrioritizedReplayBuffer, ReplayBuffer
 from d4pg_tpu.replay.uniform import TransitionBatch
 
@@ -226,7 +226,8 @@ def train(cfg: ExperimentConfig) -> dict:
 
     # --- optional network serving for remote actors (actor_main.py) ------
     receiver = weight_server = None
-    if cfg.serve:
+    actor_processes: list = []
+    if cfg.serve or cfg.actor_procs > 0:
         from d4pg_tpu.distributed.transport import TransitionReceiver
         from d4pg_tpu.distributed.weight_server import WeightServer
 
@@ -241,6 +242,38 @@ def train(cfg: ExperimentConfig) -> dict:
                                      secret=cfg.serve_secret or None)
         print(f"serving: transitions :{receiver.port} weights :{weight_server.port}",
               flush=True)
+    if cfg.actor_procs > 0:
+        # Real process-level local parallelism (the reference's mp.Process
+        # fan-out, main.py:399-405, done over the TCP plane): each process
+        # steps its own env pool on the CPU backend and streams in
+        # continuously, out of the learner's GIL entirely.
+        import multiprocessing as mp
+
+        from d4pg_tpu.actor_main import run_local_actor_process
+
+        ctx = mp.get_context("spawn")
+        connect_host = (
+            "127.0.0.1" if cfg.serve_host in ("0.0.0.0", "127.0.0.1")
+            else cfg.serve_host
+        )
+        for i in range(cfg.actor_procs):
+            proc_cfg = dataclasses.replace(
+                cfg, seed=cfg.seed + 1000 * (i + 1), actor_procs=0,
+                serve=False)
+            p = ctx.Process(
+                target=run_local_actor_process,
+                args=(proc_cfg, connect_host, receiver.port,
+                      weight_server.port, f"proc-{i}",
+                      cfg.serve_secret or None),
+                daemon=True,
+            )
+            p.start()
+            actor_processes.append(p)
+        print(f"spawned {len(actor_processes)} actor processes", flush=True)
+        if cfg.n_workers == 0:
+            # no in-process actors: wait for the fleet to fill the warmup
+            if not service.wait_until(cfg.warmup, timeout=300.0):
+                raise RuntimeError("actor processes did not reach warmup")
 
     # --- the HER-paper loop (main.py:299-368), or the decoupled async
     # actor-learner architecture of the D4PG paper (--async_actors 1) ------
@@ -267,9 +300,7 @@ def train(cfg: ExperimentConfig) -> dict:
                 config, donate=True, use_is_weights=cfg.prioritized_replay)
     else:
         multi_update = None
-    stacked_sharding = (
-        NamedSharding(mesh, P(None, DATA_AXIS)) if mesh is not None else None
-    )
+    chunk_sharding = stacked_sharding(mesh) if mesh is not None else None
 
     def _sample_chunk():
         """One K-chunk: host tree walks pick [K, B] indices, ONE storage
@@ -295,17 +326,23 @@ def train(cfg: ExperimentConfig) -> dict:
         ChunkPipeline(
             multi_update, _sample_chunk,
             write_back=_per_write_back if cfg.prioritized_replay else None,
-            sharding=stacked_sharding,
+            sharding=chunk_sharding,
             use_weights=cfg.prioritized_replay,
         )
         if K > 1 else None
     )
 
-    def _on_chunk(_state):
+    def _on_chunk(chunk_state):
+        """Per-dispatch step accounting + weight publishing. Publishes from
+        the CHUNK's output state (the `state` closure variable is rebound
+        only after pipeline.run returns — reading it here would ship params
+        from before the whole run)."""
         nonlocal lstep
         lstep += K
         if cfg.async_actors:
-            publish()  # bounded weight staleness: lag <= K steps
+            p = (chunk_state.actor_params if mesh is None
+                 else jax.device_get(chunk_state.actor_params))
+            weights.publish(p, step=lstep)  # bounded staleness: lag <= K
 
     def train_single():
         nonlocal state, lstep
@@ -471,6 +508,10 @@ def train(cfg: ExperimentConfig) -> dict:
             bus.log(lstep, last_metrics)
     ckpt.wait()
     bus.close()
+    for p in actor_processes:
+        p.terminate()
+    for p in actor_processes:
+        p.join(timeout=5.0)
     if receiver is not None:
         receiver.close()
     if weight_server is not None:

@@ -156,3 +156,19 @@ def test_full_train_determinism(tmp_path):
         return open(csv).read()
 
     assert run("a") == run("b")
+
+
+def test_strict_reference_mode():
+    """--strict_reference 1 = the reference's own hyperparameters in one
+    flag (VERDICT r1 #10)."""
+    from d4pg_tpu.config import parse_args
+
+    cfg = parse_args(["--env", "Pendulum-v1", "--strict_reference", "1"]).resolve()
+    assert cfg.v_min == -300.0 and cfg.v_max == 0.0  # main.py:86-88
+    assert cfg.reward_scale == 1.0
+    assert cfg.adam_b1 == 0.9 and cfg.adam_b2 == 0.9  # shared_adam.py:4
+    assert cfg.lr_actor == 1e-3 and cfg.lr_critic == 1e-3
+    assert cfg.updates_per_dispatch == 1
+    # default mode keeps the documented divergence
+    d = parse_args(["--env", "Pendulum-v1"]).resolve()
+    assert d.v_min == -100.0 and d.reward_scale == 0.1
