@@ -80,6 +80,12 @@ class ExperimentConfig:
     concurrent_eval: bool = True
     # distributed
     n_workers: int = 1  # --n_workers (in-process actor threads)
+    # Multi-host runtime (jax.distributed): every host starts the same
+    # train command with its own --process_id; process 0's host:port is
+    # the coordinator. Empty coordinator = single-process (default).
+    coordinator: str = ""
+    num_processes: int = 1
+    process_id: int = 0
     # Spawned local actor PROCESSES connecting through the TCP plane
     # (implies --serve): real parallelism for host-bound env stepping,
     # unlike in-process actor threads which share the learner's GIL.
@@ -222,6 +228,9 @@ def build_parser() -> argparse.ArgumentParser:
                    "evaluate on a background thread")
     p.add_argument("--n_workers", type=int, default=d.n_workers)
     p.add_argument("--actor_procs", type=int, default=d.actor_procs)
+    p.add_argument("--coordinator", default=d.coordinator)
+    p.add_argument("--num_processes", type=int, default=d.num_processes)
+    p.add_argument("--process_id", type=int, default=d.process_id)
     p.add_argument("--data_parallel", type=int, default=d.data_parallel)
     _add_bool_flag(p, "async_actors", d.async_actors,
                    "decoupled actor/learner loop")

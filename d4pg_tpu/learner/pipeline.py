@@ -41,10 +41,15 @@ class ChunkPipeline:
         write_back: Optional[Callable[[Any, np.ndarray], None]] = None,
         sharding=None,
         use_weights: bool = True,
+        fetch_td: Optional[Callable] = None,
     ):
         self._update = update_fn
         self._write_back = write_back
         self._use_weights = use_weights
+        # How to pull td_error to the host. Default: full fetch. Multi-host
+        # passes a local-shard extractor (a host can only read its own rows
+        # of the globally-sharded [K, B] td_error).
+        self._fetch_td = fetch_td or (lambda m: np.asarray(m["td_error"]))
         self._stager = DeviceStager(sample_fn, device=sharding, with_aux=True)
 
     def invalidate(self) -> None:
@@ -86,5 +91,5 @@ class ChunkPipeline:
         aux, metrics = pending
         if aux is None or self._write_back is None:
             return
-        td = np.abs(np.asarray(metrics["td_error"])) + 1e-6
+        td = np.abs(self._fetch_td(metrics)) + 1e-6
         self._write_back(aux, td)

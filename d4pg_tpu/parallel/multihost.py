@@ -1,0 +1,107 @@
+"""Multi-host learner startup: one logical device mesh across processes.
+
+The reference cannot cross hosts at all — its "distributed backend" is
+``torch.multiprocessing`` + OS shared memory on one machine (``main.py:12,
+386-388``, SURVEY.md C18). The TPU-native equivalent is ``jax.distributed``:
+every host starts the same program, ``initialize()`` forms the global
+runtime over DCN, and the SAME sharded update compiled in
+``data_parallel.py`` runs SPMD over the union of all hosts' chips with
+XLA-inserted collectives (ICI within a slice, DCN across).
+
+Simulated multi-host (SURVEY.md §4: "multi-host tests via jax.distributed-
+under-simulation") runs N local processes with virtual CPU devices — see
+``multihost_check.py`` and ``tests/test_multihost.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from d4pg_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def initialize(coordinator: str, num_processes: int, process_id: int,
+               local_device_ids: Optional[list[int]] = None) -> None:
+    """Join the multi-process JAX runtime. MUST run before anything
+    initializes a backend (train.py calls it straight after arg parsing).
+
+    ``coordinator``: ``host:port`` of process 0 (the reference has no
+    analog; this replaces nothing and adds the cross-host capability).
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+
+
+def global_mesh(model_parallel: int = 1) -> Mesh:
+    """(data, model) mesh over ALL devices of ALL processes. Device order
+    from ``jax.devices()`` is process-contiguous, so the data axis maps
+    host-local batches to host-local devices (DCN only carries gradient
+    all-reduce, not batch rows)."""
+    devices = np.array(jax.devices())
+    if devices.size % model_parallel:
+        raise ValueError(
+            f"{devices.size} devices not divisible by model_parallel={model_parallel}")
+    return Mesh(devices.reshape(-1, model_parallel), (DATA_AXIS, MODEL_AXIS))
+
+
+def make_global_batch(local_batch, mesh: Mesh, spec: P | None = None):
+    """Assemble a globally-sharded batch pytree from each process's local
+    shard: process p contributes rows [p*B_local, (p+1)*B_local) of the
+    global batch along the ``data`` axis. Each host samples from its OWN
+    replay shard (the Ape-X sharded-replay layout); rows never cross hosts.
+
+    ``spec`` defaults to ``P('data')`` (plain [B, ...] batches); pass
+    ``P(None, 'data')`` for stacked [K, B, ...] chunks.
+    """
+    spec = P(DATA_AXIS) if spec is None else spec
+    sharding = NamedSharding(mesh, spec)
+    axis = list(spec).index(DATA_AXIS)
+
+    def to_global(x):
+        x = np.asarray(x)
+        global_shape = list(x.shape)
+        global_shape[axis] *= jax.process_count()
+        return jax.make_array_from_process_local_data(
+            sharding, x, tuple(global_shape))
+
+    return jax.tree_util.tree_map(to_global, local_batch)
+
+
+def make_global_chunk(local_chunk, mesh: Mesh):
+    """:func:`make_global_batch` for stacked [K, B, ...] chunks (the K scan
+    axis replicated, B sharded over ``data``)."""
+    return make_global_batch(local_chunk, mesh, spec=P(None, DATA_AXIS))
+
+
+def local_rows(global_array, axis: int = 0) -> np.ndarray:
+    """This process's contribution of a data-axis-sharded array (the
+    inverse of :func:`make_global_batch`), as host numpy — e.g. the local
+    slice of the global ``td_error`` that feeds this host's PER
+    write-back. Non-addressable shards are never touched."""
+    seen = {}
+    for s in global_array.addressable_shards:
+        start = s.index[axis].start or 0
+        if start not in seen:
+            seen[start] = np.asarray(s.data)
+    return np.concatenate(
+        [seen[k] for k in sorted(seen)], axis=axis)
+
+
+def replicate_state_global(init_fn, mesh: Mesh):
+    """Build the train state replicated across ALL processes' devices.
+
+    A host-local ``device_put`` cannot address other hosts' devices, so the
+    state is constructed INSIDE jit with replicated out_shardings — every
+    process traces the same ``init_fn`` (same config, same seed) and XLA
+    materializes identical replicas everywhere.
+    """
+    repl = NamedSharding(mesh, P())
+    return jax.jit(init_fn, out_shardings=repl)()

@@ -103,22 +103,42 @@ def infer_dims(cfg: ExperimentConfig) -> tuple[int | tuple, int, np.dtype]:
 
 def train(cfg: ExperimentConfig) -> dict:
     cfg = cfg.resolve()
+    # Multi-host SPMD (parallel/multihost.py): every host runs this same
+    # function with identical flags; host-side work (replay, actors) is
+    # per-host, device work spans the global mesh. Process 0 owns io/eval.
+    multi_host = jax.process_count() > 1
+    is_main = jax.process_index() == 0
     run_dir = os.path.join(cfg.log_dir, cfg.run_name())
-    os.makedirs(run_dir, exist_ok=True)
+    if is_main:
+        os.makedirs(run_dir, exist_ok=True)
 
     obs_dim, act_dim, obs_dtype = infer_dims(cfg)
     config = cfg.learner_config(obs_dim, act_dim)
 
     # --- learner state + update (single-device or sharded) ----------------
-    state = init_state(config, jax.random.key(cfg.seed))
     mesh = None
-    if cfg.data_parallel > 1:
+    if multi_host:
+        from functools import partial
+
+        from d4pg_tpu.parallel import multihost
+
+        mesh = multihost.global_mesh()
+        # identical seed on every host -> identical replicated state;
+        # constructed inside jit because host device_put cannot address
+        # other hosts' devices
+        state = multihost.replicate_state_global(
+            partial(init_state, config, jax.random.key(cfg.seed)), mesh)
+        update = make_sharded_update(config, mesh, donate=True,
+                                     use_is_weights=cfg.prioritized_replay)
+    elif cfg.data_parallel > 1:
         mesh = make_mesh(MeshSpec(data_parallel=cfg.data_parallel),
                          devices=jax.devices()[:cfg.data_parallel])
-        state = replicate_state(state, mesh)
+        state = replicate_state(init_state(config, jax.random.key(cfg.seed)),
+                                mesh)
         update = make_sharded_update(config, mesh, donate=True,
                                      use_is_weights=cfg.prioritized_replay)
     else:
+        state = init_state(config, jax.random.key(cfg.seed))
         update = make_update(config, donate=True,
                              use_is_weights=cfg.prioritized_replay)
 
@@ -135,10 +155,10 @@ def train(cfg: ExperimentConfig) -> dict:
         storage = (
             "device"
             if jax.default_backend() != "cpu" and cfg.data_parallel == 1
-            and ring_bytes < 8e9
+            and not multi_host and ring_bytes < 8e9
             else "host"
         )
-    elif storage == "device" and cfg.data_parallel > 1:
+    elif storage == "device" and (cfg.data_parallel > 1 or multi_host):
         # The ring lives on ONE device; a sharded learner would re-pay the
         # O(batch bytes) cross-device copy every dispatch (and fail outright
         # on a multi-host mesh). Refuse instead of silently inverting the
@@ -158,17 +178,23 @@ def train(cfg: ExperimentConfig) -> dict:
     beta = LinearSchedule(cfg.per_beta_steps, 1.0, cfg.per_beta0)
     service = ReplayService(buffer)
 
-    # --- io ---------------------------------------------------------------
-    bus = MetricsBus(echo=True)
-    try:
-        bus.add_sink(TensorBoardSink(run_dir))
-    except Exception as e:  # tensorboard optional at runtime
-        print(f"tensorboard disabled: {e}")
-    bus.add_sink(CsvLogger(os.path.join(run_dir, "returns.csv"),
-                           ["avg_test_reward", "ewma_test_reward"]))
-    ckpt = CheckpointManager(os.path.join(run_dir, "ckpt"))
+    # --- io (process 0 owns all of it in multi-host mode) ----------------
+    bus = MetricsBus(echo=is_main)
+    ckpt = None
+    if is_main:
+        try:
+            bus.add_sink(TensorBoardSink(run_dir))
+        except Exception as e:  # tensorboard optional at runtime
+            print(f"tensorboard disabled: {e}")
+        bus.add_sink(CsvLogger(os.path.join(run_dir, "returns.csv"),
+                               ["avg_test_reward", "ewma_test_reward"]))
+        ckpt = CheckpointManager(os.path.join(run_dir, "ckpt"))
     extra: dict = {"env_steps": 0}
-    if cfg.resume and ckpt.latest_step is not None:
+    if cfg.resume and multi_host:
+        raise ValueError(
+            "--resume is not supported with the multi-host runtime yet; "
+            "restore single-host, then relaunch distributed")
+    if cfg.resume and ckpt is not None and ckpt.latest_step is not None:
         state, extra = ckpt.restore(state if mesh is None else jax.device_get(state))
         if mesh is not None:
             state = replicate_state(state, mesh)
@@ -527,6 +553,18 @@ def train(cfg: ExperimentConfig) -> dict:
 
 def main(argv=None):
     cfg = parse_args(argv)
+    if cfg.coordinator:
+        # Join the multi-host runtime BEFORE any backend init; after this,
+        # jax.devices() spans every process and --data_parallel can cover
+        # the global device count (parallel/multihost.py). Each host runs
+        # this same command with its own --process_id.
+        from d4pg_tpu.parallel import multihost
+
+        multihost.initialize(cfg.coordinator, cfg.num_processes,
+                             cfg.process_id)
+        print(f"joined multi-host runtime: process {cfg.process_id}/"
+              f"{cfg.num_processes}, {len(jax.devices())} global devices",
+              flush=True)
     result = train(cfg)
     print("final:", result)
 

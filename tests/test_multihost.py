@@ -1,0 +1,50 @@
+"""Simulated multi-host: two local processes form one jax.distributed mesh
+and run the sharded D4PG update (SURVEY.md §4; VERDICT r1 #8). Spawned as
+real subprocesses — jax.distributed state is process-global and must not
+contaminate the test process."""
+
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_processes_form_one_mesh():
+    port = _free_port()
+    env = dict(os.environ)
+    env.update({
+        # stripped axon plugin + explicit CPU: robust even when the TPU
+        # tunnel is wedged (see .claude/skills/verify/SKILL.md)
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+    })
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "d4pg_tpu.parallel.multihost_check",
+             "--coordinator", f"127.0.0.1:{port}",
+             "--num_processes", "2", "--process_id", str(i)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+        assert p.returncode == 0, out[-2000:]
+    oks = [line for out in outs for line in out.splitlines()
+           if line.startswith("multihost_check OK")]
+    assert len(oks) == 2
+    assert "mesh 8 devices" in oks[0]
+    # replicas agree: both processes report identical losses
+    assert oks[0].split("losses")[1] == oks[1].split("losses")[1]
