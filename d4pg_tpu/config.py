@@ -42,6 +42,12 @@ class ExperimentConfig:
     # picks indices; per-dispatch H2D is O(indices) not O(batch bytes));
     # 'auto' selects device on an accelerator single-device learner.
     replay_storage: str = "auto"
+    # Fully-fused replay+learn path (learner/fused.py): PER trees join the
+    # ring in HBM and sample/gather/update/priority-write-back all run
+    # inside the scanned dispatch — zero per-chunk host round trips, zero
+    # priority staleness. 'auto' = on whenever storage resolves to device
+    # and the learner is single-device; 'off' keeps host trees.
+    fused_replay: str = "auto"
     # K learner updates fused into one device dispatch via lax.scan
     # (~16x single-dispatch throughput at K=16 on one chip; PER priority
     # write-back then lags by <= 2K steps with the prefetch pipeline).
@@ -198,6 +204,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n_steps", type=int, default=d.n_steps)
     p.add_argument("--replay_storage", choices=("auto", "host", "device"),
                    default=d.replay_storage)
+    p.add_argument("--fused_replay", choices=("auto", "on", "off"),
+                   default=d.fused_replay)
     p.add_argument("--updates_per_dispatch", type=int,
                    default=d.updates_per_dispatch)
     p.add_argument("--gamma", type=float, default=d.gamma)

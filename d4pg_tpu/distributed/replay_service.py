@@ -111,6 +111,18 @@ class ReplayService:
                 self.buffer.update_priorities(idx, priorities,
                                               generation=generation)
 
+    def drain_device(self) -> int:
+        """Flush rows staged by a fused-path buffer
+        (``replay/fused_buffer.py``) onto the device. Called by the
+        LEARNER thread at chunk boundaries — it is the single owner of the
+        device handles, so the drain thread's ``add`` only stages host
+        rows and never dispatches device work."""
+        drain = getattr(self.buffer, "drain", None)
+        if drain is None:
+            return 0
+        with self._buffer_lock:
+            return drain()
+
     @property
     def env_steps(self) -> int:
         with self._lock:

@@ -26,11 +26,17 @@ class WeightStore:
         self._params: Any = None
         self._step = 0
 
-    def publish(self, params: Any, step: int) -> int:
-        """Learner-side: publish new actor params (device arrays are pulled
-        to host numpy so readers never hold device references). Returns the
-        new version."""
-        host = jax.tree_util.tree_map(lambda x: np.asarray(x), params)
+    def publish(self, params: Any, step: int, to_host: bool = True) -> int:
+        """Learner-side: publish new actor params. ``to_host=True`` pulls
+        device arrays to host numpy (a BLOCKING D2H sync) so readers never
+        hold device references. The fused learner path instead publishes
+        ``to_host=False`` with an on-device copy: the copy dispatch is
+        async, so back-to-back chunk dispatches never stall; in-process
+        readers jit-apply device params directly, and host consumers (the
+        TCP weight server) ``np.asarray`` lazily off the learner thread.
+        Returns the new version."""
+        host = (jax.tree_util.tree_map(lambda x: np.asarray(x), params)
+                if to_host else params)
         with self._lock:
             self._version += 1
             self._params = host

@@ -1,0 +1,168 @@
+"""Device-resident PER sum/min trees: priority state lives in HBM.
+
+TPU-native redesign of the prioritized-replay data path (the reference
+keeps its segment trees in host Python lists and walks them one sample at
+a time, ``prioritized_replay_memory.py:33-162``). On a tunneled or
+PCIe-attached accelerator every host round trip costs more than the whole
+K-step update, so the trees move onto the device next to the transition
+ring (``replay/device_ring.py``) and the ENTIRE per-step replay protocol
+— stratified proportional sampling, importance weights, priority
+write-back — becomes pure ``jnp`` ops that fuse into the scanned learner
+update (``learner/fused.py``). One dispatch then carries K grad steps
+with zero host involvement and zero priority staleness (the reference
+writes priorities once per step, ``ddpg.py:252-255``; the host-pipelined
+chunk path bounds staleness by ~2K; this path restores exact per-step
+semantics *inside* the scan).
+
+Layout matches the host trees (``replay/segment_tree.py``): one flat
+array of ``2 * capacity`` (power of two) nodes, root at 1, leaf ``i`` at
+``capacity + i``. All ops are batched:
+
+  - ``set_leaves``: scatter the B leaves, then repair ancestors level by
+    level — every touched parent is recomputed from its (already-written)
+    children, so duplicate parents among the B paths all write identical
+    values and need no dedup;
+  - ``sample``: B stratified inverse-CDF queries descend in lock-step,
+    log2(N) gather/where rounds;
+  - trees are float32 (device-friendly); with ~1e6 leaves the prefix-sum
+    rounding error is ~1e-7 of total mass per level — sampling noise well
+    below the stochasticity already present. IS weights read exact leaf
+    values.
+
+Duplicate sampled indices within a batch: ``set_leaves`` keeps one
+write-back winner per slot (scatter set), matching the reference's
+last-write-wins sequential loop up to ordering.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from d4pg_tpu.replay.segment_tree import next_pow2
+
+
+class PerTrees(NamedTuple):
+    """Device PER state; a pure pytree (donate/checkpoint-able)."""
+
+    sum_tree: Array  # [2 * capacity] float32, node 1 is the root
+    min_tree: Array  # [2 * capacity] float32
+    max_priority: Array  # [] float32, running max of RAW priorities
+
+    @property
+    def capacity(self) -> int:
+        return self.sum_tree.shape[0] // 2
+
+
+def _levels(capacity: int) -> int:
+    return int(math.log2(capacity))
+
+
+def init(capacity: int) -> PerTrees:
+    """Fresh trees for ``capacity`` (rounded up to a power of two) slots."""
+    cap = next_pow2(int(capacity))
+    return PerTrees(
+        sum_tree=jnp.zeros(2 * cap, jnp.float32),
+        min_tree=jnp.full(2 * cap, jnp.inf, jnp.float32),
+        max_priority=jnp.ones((), jnp.float32),
+    )
+
+
+def set_leaves(trees: PerTrees, idx: Array, p_alpha: Array) -> PerTrees:
+    """Write ``p_alpha`` ([B], already ``priority ** alpha``) at leaves
+    ``idx`` ([B] int) and repair both trees' ancestors."""
+    cap = trees.capacity
+    node = idx.astype(jnp.int32) + cap
+    s = trees.sum_tree.at[node].set(p_alpha.astype(jnp.float32))
+    m = trees.min_tree.at[node].set(p_alpha.astype(jnp.float32))
+    for _ in range(_levels(cap)):
+        node = node >> 1
+        left = node << 1
+        s = s.at[node].set(s[left] + s[left | 1])
+        m = m.at[node].set(jnp.minimum(m[left], m[left | 1]))
+    return PerTrees(s, m, trees.max_priority)
+
+
+def insert(trees: PerTrees, idx: Array, alpha: float) -> PerTrees:
+    """New transitions enter with ``max_priority ** alpha``
+    (``prioritized_replay_memory.py:251-256``). Pad ``idx`` by repeating a
+    real slot — duplicate writes of the same value are harmless, so callers
+    can bucket sizes for compile-count control."""
+    p = jnp.full(idx.shape, trees.max_priority**alpha, jnp.float32)
+    return set_leaves(trees, idx, p)
+
+
+def update_from_td(
+    trees: PerTrees, idx: Array, td_error: Array, alpha: float,
+    eps: float = 1e-6,
+) -> PerTrees:
+    """Priority write-back from the TD errors of a sampled batch
+    (``ddpg.py:252-255``: priority = |td| + eps, stored as ``p ** alpha``,
+    running max tracked on the raw priority)."""
+    p = jnp.abs(td_error) + eps
+    trees = set_leaves(trees, idx, p**alpha)
+    return trees._replace(
+        max_priority=jnp.maximum(trees.max_priority, p.max())
+    )
+
+
+def sample(
+    trees: PerTrees, key: Array, batch_size: int, limit: Array
+) -> Array:
+    """Stratified proportional sampling: B strata over the total mass, one
+    uniform draw each, lock-step inverse-CDF descent (the vectorized form
+    of ``prioritized_replay_memory.py:258-265``). ``limit`` (traced int,
+    the buffer's live size) clips prefix overshoot onto written leaves."""
+    total = trees.sum_tree[1]
+    u = jax.random.uniform(key, (batch_size,))
+    p = (jnp.arange(batch_size) + u) * (total / batch_size)
+    node = jnp.ones(batch_size, jnp.int32)
+    for _ in range(_levels(trees.capacity)):
+        left = node << 1
+        left_sum = trees.sum_tree[left]
+        go_right = p >= left_sum
+        p = jnp.where(go_right, p - left_sum, p)
+        node = jnp.where(go_right, left | 1, left)
+    idx = node - trees.capacity
+    return jnp.minimum(idx, jnp.maximum(limit - 1, 0))
+
+
+def is_weights(
+    trees: PerTrees, idx: Array, beta: Array, size: Array
+) -> Array:
+    """``(p_i * N) ** -beta`` normalized by the max weight (computed from
+    the min tree) — ``prioritized_replay_memory.py:299-313``."""
+    total = trees.sum_tree[1]
+    n = size.astype(jnp.float32)
+    p_min = trees.min_tree[1] / total
+    max_weight = (p_min * n) ** (-beta)
+    p = trees.sum_tree[trees.capacity + idx] / total
+    return ((p * n) ** (-beta) / max_weight).astype(jnp.float32)
+
+
+_insert_jit = None
+
+
+def insert_jitted(trees: PerTrees, idx, alpha: float) -> PerTrees:
+    """Dispatch :func:`insert` as ONE device computation (eager jnp would
+    pay a per-op round trip on a tunneled accelerator). Donates ``trees``
+    — the caller must own the handle (single-writer: the learner thread).
+    Callers bucket ``idx`` length (pad by repeating a live slot) so only
+    O(log n) shapes compile."""
+    global _insert_jit
+    if _insert_jit is None:
+        _insert_jit = jax.jit(insert, static_argnames=("alpha",),
+                              donate_argnums=(0,))
+    return _insert_jit(trees, idx, alpha=alpha)
+
+
+def beta_schedule(step: Array, beta0: float, beta_steps: int) -> Array:
+    """PER beta annealing as a pure in-jit function of the learner step —
+    the device twin of ``replay/schedule.py``'s LinearSchedule (beta0 -> 1
+    over ``beta_steps``, then clamped)."""
+    frac = jnp.clip(step.astype(jnp.float32) / float(beta_steps), 0.0, 1.0)
+    return beta0 + frac * (1.0 - beta0)

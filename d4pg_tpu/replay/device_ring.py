@@ -23,16 +23,8 @@ from functools import partial
 
 import numpy as np
 
+from d4pg_tpu.replay.segment_tree import next_pow2 as _bucket
 from d4pg_tpu.replay.uniform import TransitionBatch
-
-
-def _bucket(n: int) -> int:
-    """Smallest power of two >= n (bounds the number of insert shapes XLA
-    compiles)."""
-    m = 1
-    while m < n:
-        m *= 2
-    return m
 
 
 class DeviceStore:
@@ -81,6 +73,12 @@ class DeviceStore:
 
         self._insert = _insert
         self._gather = _gather
+
+    @property
+    def arrays(self) -> TransitionBatch:
+        """The raw [capacity, ...] device arrays (read-only input to the
+        fused learner path, ``learner/fused.py``)."""
+        return self._storage
 
     def write(self, idx: np.ndarray, batch: TransitionBatch) -> None:
         n = len(idx)

@@ -1,0 +1,112 @@
+"""Replay buffer for the fused device path: ring + PER trees in HBM.
+
+Companion to ``learner/fused.py``. Ownership model (the part that makes
+cross-thread donation safe): ``add`` — called from the ReplayService
+drain thread under the buffer lock — only STAGES host rows; every device
+mutation (ring scatter, tree insert, and the fused chunk's tree
+write-back) happens on the learner thread, which is the single owner of
+the ``trees``/storage handles. ``drain()`` flushes staged rows at chunk
+boundaries, so inserts take effect between chunks — the same semantics
+the host-PER path gets from its buffer lock, without the learner ever
+blocking on actor ingest.
+
+The generation guard the host path needs (``prioritized.py`` — a sampled
+slot overwritten before its priority lands) is structurally unnecessary
+here: priorities are written INSIDE the chunk, and inserts only happen
+between chunks on the same thread.
+
+Reference scope covered: ``prioritized_replay_memory.py:224-335``
+(priority lifecycle) + ``replay_memory.py:14-80`` (ring), relocated to
+the accelerator.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from d4pg_tpu.replay import device_per as dper
+from d4pg_tpu.replay.device_ring import DeviceStore, _bucket
+from d4pg_tpu.replay.uniform import TransitionBatch
+
+
+class FusedDeviceReplay:
+    """Fixed-capacity device ring + (optionally) device PER trees."""
+
+    def __init__(
+        self,
+        capacity: int,
+        obs_dim: int | tuple,
+        act_dim: int,
+        alpha: float = 0.6,
+        prioritized: bool = True,
+        obs_dtype=None,
+        device=None,
+    ):
+        self.capacity = int(capacity)
+        obs_shape = (obs_dim,) if np.isscalar(obs_dim) else tuple(obs_dim)
+        if obs_dtype is None:
+            obs_dtype = np.float32 if len(obs_shape) == 1 else np.uint8
+        self._store = DeviceStore(self.capacity, obs_shape, act_dim,
+                                  obs_dtype, device=device)
+        self.prioritized = bool(prioritized)
+        self.alpha = float(alpha)
+        self.trees = dper.init(self.capacity) if prioritized else None
+        self.size = 0
+        self.head = 0
+        self._staged: list[TransitionBatch] = []
+        self._staged_rows = 0
+
+    # -- ingest side (any thread, under the service's buffer lock) ---------
+    def add(self, batch: TransitionBatch) -> None:
+        """Stage host rows; cheap (no device work, no jit dispatch)."""
+        n = batch.obs.shape[0]
+        if n == 0:
+            return
+        if n > self.capacity:
+            raise ValueError(f"batch of {n} exceeds capacity {self.capacity}")
+        self._staged.append(
+            TransitionBatch(*[np.asarray(v) for v in batch]))
+        self._staged_rows += n
+
+    def __len__(self) -> int:
+        # staged rows count toward warmup gates — they WILL be trained on
+        # (drained before the next chunk)
+        return min(self.size + self._staged_rows, self.capacity)
+
+    # -- learner side (single owner of the device handles) -----------------
+    @property
+    def storage(self) -> TransitionBatch:
+        return self._store.arrays
+
+    def drain(self) -> int:
+        """Flush staged rows to the device (ring scatter + tree insert at
+        ``max_priority ** alpha``). Learner thread only. Returns rows
+        flushed."""
+        if not self._staged:
+            return 0
+        batch = (self._staged[0] if len(self._staged) == 1 else
+                 TransitionBatch(*[
+                     np.concatenate([np.asarray(b[f]) for b in self._staged])
+                     for f in range(len(self._staged[0]))]))
+        self._staged.clear()
+        self._staged_rows = 0
+        n = batch.obs.shape[0]
+        if n > self.capacity:
+            # more staged than the ring holds: older rows would only be
+            # overwritten — and duplicate slot indices in one scatter have
+            # an unspecified winner, so keep exactly the newest `capacity`
+            self.head = int((self.head + (n - self.capacity)) % self.capacity)
+            batch = TransitionBatch(*[v[-self.capacity:] for v in batch])
+            n = self.capacity
+        idx = ((self.head + np.arange(n)) % self.capacity).astype(np.int32)
+        self._store.write(idx, batch)
+        if self.trees is not None:
+            m = _bucket(n)
+            if m != n:
+                # pad by repeating live slots: duplicate writes of the same
+                # value are harmless to the trees (see device_per.insert)
+                idx = np.concatenate([idx, np.full(m - n, idx[0], np.int32)])
+            self.trees = dper.insert_jitted(self.trees, idx, self.alpha)
+        self.head = int((self.head + n) % self.capacity)
+        self.size = int(min(self.size + n, self.capacity))
+        return n

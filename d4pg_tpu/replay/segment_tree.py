@@ -22,11 +22,17 @@ from __future__ import annotations
 import numpy as np
 
 
-def _next_pow2(n: int) -> int:
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n — the shared capacity/bucket rounding
+    used by the host trees, the device ring's insert buckets and the
+    device trees."""
     p = 1
     while p < n:
         p <<= 1
     return p
+
+
+_next_pow2 = next_pow2
 
 
 class _Tree:

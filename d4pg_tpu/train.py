@@ -166,7 +166,25 @@ def train(cfg: ExperimentConfig) -> dict:
         raise ValueError(
             "--replay_storage device is incompatible with --data_parallel > 1; "
             "use 'host' (or 'auto', which resolves this automatically)")
-    if cfg.prioritized_replay:
+    # Fully-fused replay+learn path (learner/fused.py): the PER trees join
+    # the ring in HBM and the whole per-step replay protocol runs inside
+    # the scanned dispatch — zero per-chunk host round trips, zero priority
+    # staleness (at K=1 this IS the reference's exact per-step write-back,
+    # ddpg.py:252-255, executed on device).
+    fused = cfg.fused_replay != "off" and storage == "device" and mesh is None
+    if cfg.fused_replay == "on" and not fused:
+        raise ValueError(
+            "--fused_replay on requires device replay storage on a "
+            "single-device learner (storage resolved to "
+            f"{storage!r}, data_parallel={cfg.data_parallel})")
+    if fused:
+        from d4pg_tpu.replay.fused_buffer import FusedDeviceReplay
+
+        buffer = FusedDeviceReplay(cfg.memory_size, obs_dim, act_dim,
+                                   alpha=cfg.per_alpha,
+                                   prioritized=cfg.prioritized_replay,
+                                   obs_dtype=obs_dtype)
+    elif cfg.prioritized_replay:
         buffer = PrioritizedReplayBuffer(cfg.memory_size, obs_dim, act_dim,
                                          alpha=cfg.per_alpha, seed=cfg.seed,
                                          obs_dtype=obs_dtype, storage=storage)
@@ -174,7 +192,7 @@ def train(cfg: ExperimentConfig) -> dict:
         buffer = ReplayBuffer(cfg.memory_size, obs_dim, act_dim, seed=cfg.seed,
                               obs_dtype=obs_dtype, storage=storage)
     if cfg.debug:
-        print(f"replay storage: {storage}", flush=True)
+        print(f"replay storage: {storage} (fused={fused})", flush=True)
     beta = LinearSchedule(cfg.per_beta_steps, 1.0, cfg.per_beta0)
     service = ReplayService(buffer)
 
@@ -316,7 +334,7 @@ def train(cfg: ExperimentConfig) -> dict:
     # data parallelism: batches are stacked [K, B, ...] with K replicated
     # (the scan axis) and B sharded over ``data``.
     K = max(1, cfg.updates_per_dispatch)
-    if K > 1:
+    if K > 1 and not fused:
         if mesh is not None:
             multi_update = make_sharded_multi_update(
                 config, mesh, donate=True,
@@ -327,6 +345,56 @@ def train(cfg: ExperimentConfig) -> dict:
     else:
         multi_update = None
     chunk_sharding = stacked_sharding(mesh) if mesh is not None else None
+
+    # Fully-fused chunks (learner/fused.py): sample + gather + update +
+    # priority write-back inside ONE scanned dispatch against the
+    # device-resident ring and trees. Cached per remainder size k.
+    fused_fns: dict[int, object] = {}
+
+    def fused_for(k: int):
+        if k not in fused_fns:
+            from d4pg_tpu.learner.fused import make_fused_chunk
+
+            fused_fns[k] = make_fused_chunk(
+                config, k=k, batch_size=cfg.batch_size,
+                prioritized=cfg.prioritized_replay, alpha=cfg.per_alpha,
+                beta0=cfg.per_beta0, beta_steps=cfg.per_beta_steps,
+                donate=True)
+        return fused_fns[k]
+
+    # whole-tree on-device param copy in ONE dispatch (async publish below)
+    copy_params = jax.jit(
+        lambda p: jax.tree_util.tree_map(jnp.copy, p))
+
+    def train_steps_fused(n: int):
+        """n fused updates. The only host work per chunk is draining staged
+        actor rows onto the device; dispatches run back-to-back with no
+        host round trip, so the learner never stalls on the tunnel."""
+        nonlocal state, lstep
+        metrics = None
+        done = 0
+        while done < n:
+            k = min(K, n - done)
+            fn = fused_for(k)
+            service.drain_device()
+            if cfg.prioritized_replay:
+                state, buffer.trees, metrics = fn(
+                    state, buffer.trees, buffer.storage, buffer.size)
+            else:
+                state, metrics = fn(state, buffer.storage, buffer.size)
+            done += k
+            lstep += k
+            if cfg.async_actors:
+                # bounded staleness <= K without stalling the dispatch
+                # pipeline: an on-device param copy (async dispatch; the
+                # next chunk's donation would otherwise invalidate the
+                # buffers readers hold) instead of a blocking D2H pull
+                weights.publish(copy_params(state.actor_params),
+                                step=lstep, to_host=False)
+        if metrics is None:
+            return None
+        return {name: metrics[name][-1]
+                for name in ("critic_loss", "actor_loss", "q_mean")}
 
     def _sample_chunk():
         """One K-chunk: host tree walks pick [K, B] indices, ONE storage
@@ -355,7 +423,7 @@ def train(cfg: ExperimentConfig) -> dict:
             sharding=chunk_sharding,
             use_weights=cfg.prioritized_replay,
         )
-        if K > 1 else None
+        if K > 1 and not fused else None
     )
 
     def _on_chunk(chunk_state):
@@ -394,6 +462,8 @@ def train(cfg: ExperimentConfig) -> dict:
     def train_steps(n: int):
         """n updates: pipelined K-chunks, then single-dispatch remainder."""
         nonlocal state
+        if fused:
+            return train_steps_fused(n)
         metrics = None
         n_chunks, remainder = (n // K, n % K) if K > 1 else (0, n)
         if n_chunks:

@@ -63,7 +63,8 @@ def test_per_device_storage_roundtrip(rng):
     assert np.asarray(b2.obs).shape == (8, 4)
 
 
-def test_train_with_device_storage(tmp_path):
+@pytest.mark.parametrize("fused", ["on", "off"])
+def test_train_with_device_storage(tmp_path, fused):
     from d4pg_tpu.config import ExperimentConfig
     from d4pg_tpu.train import train
 
@@ -72,7 +73,7 @@ def test_train_with_device_storage(tmp_path):
         n_cycles=2, episodes_per_cycle=1, train_steps_per_cycle=16,
         eval_trials=1, batch_size=16, memory_size=2000,
         log_dir=str(tmp_path), hidden=(16, 16), n_atoms=11,
-        v_min=-5.0, v_max=0.0, replay_storage="device",
+        v_min=-5.0, v_max=0.0, replay_storage="device", fused_replay=fused,
     )
     metrics = train(cfg)
     assert np.isfinite(metrics["critic_loss"])
