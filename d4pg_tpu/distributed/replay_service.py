@@ -75,18 +75,21 @@ class ReplayService:
             self._heartbeats[actor_id] = time.monotonic()
 
     # -- learner-facing ----------------------------------------------------
-    def sample(self, batch_size: int, beta: float = 0.4):
+    def sample(self, batch_size: int, beta: float = 0.4,
+               weight_base: float | None = None):
         """PER: (batch, weights, idx, generation); uniform: batch. Mirrors
         the learner's buffer-kind dispatch (``ddpg.py:187-197``); the
         generation snapshot guards the priority write-back against the
         drain thread overwriting a sampled slot in flight."""
         with self._buffer_lock:
             if isinstance(self.buffer, PrioritizedReplayBuffer):
-                batch, w, idx = self.buffer.sample(batch_size, beta=beta)
+                batch, w, idx = self.buffer.sample(
+                    batch_size, beta=beta, weight_base=weight_base)
                 return batch, w, idx, self.buffer.generation[idx].copy()
             return self.buffer.sample(batch_size)
 
-    def sample_chunk(self, k: int, batch_size: int, beta: float = 0.4):
+    def sample_chunk(self, k: int, batch_size: int, beta: float = 0.4,
+                     weight_base: float | None = None):
         """K stacked batches in one storage gather: (batches [K, B, ...],
         weights-or-None, idx [K, B], generation-or-None [K, B]) — the
         K-updates-per-dispatch sample path (``learner/pipeline.py``). The
@@ -94,11 +97,19 @@ class ReplayService:
         slots the drain thread overwrote in flight."""
         with self._buffer_lock:
             if isinstance(self.buffer, PrioritizedReplayBuffer):
-                batches, w, idx = self.buffer.sample_chunk(k, batch_size,
-                                                           beta=beta)
+                batches, w, idx = self.buffer.sample_chunk(
+                    k, batch_size, beta=beta, weight_base=weight_base)
                 return batches, w, idx, self.buffer.generation[idx].copy()
             batches, _, idx = self.buffer.sample_chunk(k, batch_size)
             return batches, None, idx, None
+
+    def weight_base(self) -> float | None:
+        """The local shard's IS-weight base ``z`` (see
+        ``PrioritizedReplayBuffer.weight_base``); None for uniform replay."""
+        with self._buffer_lock:
+            if isinstance(self.buffer, PrioritizedReplayBuffer):
+                return self.buffer.weight_base()
+            return None
 
     def update_priorities(
         self,

@@ -21,14 +21,28 @@ from d4pg_tpu.learner.state import D4PGState
 
 
 class CheckpointManager:
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 active_processes: set[int] | None = None):
+        """``active_processes``: in a multi-host runtime, the processes
+        participating in checkpoint io. The training driver saves the
+        (host-replicated) state from process 0 only, so it passes ``{0}``
+        — otherwise Orbax's internal barriers would wait on processes
+        that never construct a manager."""
         self._dir = os.path.abspath(directory)
+        # created here, not by Orbax: `create=True` is unsupported when
+        # `active_processes` restricts the participant set
         os.makedirs(self._dir, exist_ok=True)
+        mp_kwargs = (
+            dict(create=False,
+                 multiprocessing_options=ocp.options.MultiprocessingOptions(
+                     primary_host=0, active_processes=active_processes,
+                     barrier_sync_key_prefix="ckpt-p0"))
+            if active_processes is not None else dict(create=True)
+        )
         self._mgr = ocp.CheckpointManager(
             self._dir,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True
-            ),
+                max_to_keep=max_to_keep, **mp_kwargs),
         )
 
     @staticmethod

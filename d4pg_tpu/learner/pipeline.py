@@ -42,6 +42,7 @@ class ChunkPipeline:
         sharding=None,
         use_weights: bool = True,
         fetch_td: Optional[Callable] = None,
+        put_fn: Optional[Callable] = None,
     ):
         self._update = update_fn
         self._write_back = write_back
@@ -50,7 +51,10 @@ class ChunkPipeline:
         # passes a local-shard extractor (a host can only read its own rows
         # of the globally-sharded [K, B] td_error).
         self._fetch_td = fetch_td or (lambda m: np.asarray(m["td_error"]))
-        self._stager = DeviceStager(sample_fn, device=sharding, with_aux=True)
+        # put_fn: custom staging (multi-host global-array assembly);
+        # default is device_put onto ``sharding``.
+        self._stager = DeviceStager(sample_fn, device=sharding,
+                                    with_aux=True, put_fn=put_fn)
 
     def invalidate(self) -> None:
         """Drop the staged chunk (sync-mode cycle boundary: train only on

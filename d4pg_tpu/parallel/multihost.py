@@ -40,6 +40,18 @@ def initialize(coordinator: str, num_processes: int, process_id: int,
     )
 
 
+def barrier(name: str) -> None:
+    """Cross-process sync point. Call once right after :func:`initialize`
+    (while all processes are still in lockstep) so the collective context
+    (gloo on the CPU-simulation backend) is created well inside its
+    ~30 s init timeout — per-role setup (TensorBoard import, Orbax,
+    evaluator) skews processes by more than that otherwise — and again
+    before the training loop to align the first sharded update."""
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
 def global_mesh(model_parallel: int = 1) -> Mesh:
     """(data, model) mesh over ALL devices of ALL processes. Device order
     from ``jax.devices()`` is process-contiguous, so the data axis maps
@@ -85,7 +97,11 @@ def local_rows(global_array, axis: int = 0) -> np.ndarray:
     """This process's contribution of a data-axis-sharded array (the
     inverse of :func:`make_global_batch`), as host numpy — e.g. the local
     slice of the global ``td_error`` that feeds this host's PER
-    write-back. Non-addressable shards are never touched."""
+    write-back. ``axis`` MUST be the sharded (data) axis: 0 for [B]
+    arrays, 1 for stacked [K, B] chunk outputs — deduplication keys on
+    the shard start index along that axis, so passing a replicated axis
+    would silently collapse everything to one shard. Non-addressable
+    shards are never touched."""
     seen = {}
     for s in global_array.addressable_shards:
         start = s.index[axis].start or 0
@@ -93,6 +109,19 @@ def local_rows(global_array, axis: int = 0) -> np.ndarray:
             seen[start] = np.asarray(s.data)
     return np.concatenate(
         [seen[k] for k in sorted(seen)], axis=axis)
+
+
+def global_min_scalar(x: float) -> float:
+    """Min of a host scalar across all processes (one tiny allgather) —
+    e.g. the PER IS-weight base ``z = p_min_frac * N``: normalizing every
+    host's weights by the same global ``z ** -beta`` keeps gradient
+    contributions consistently scaled across shards (a per-host normalizer
+    would bias hosts whose buffers hold smaller minimum priorities)."""
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        np.asarray(x, np.float64))
+    return float(np.min(gathered))
 
 
 def replicate_state_global(init_fn, mesh: Mesh):

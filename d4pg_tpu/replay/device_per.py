@@ -78,7 +78,12 @@ def set_leaves(trees: PerTrees, idx: Array, p_alpha: Array) -> PerTrees:
     cap = trees.capacity
     node = idx.astype(jnp.int32) + cap
     s = trees.sum_tree.at[node].set(p_alpha.astype(jnp.float32))
-    m = trees.min_tree.at[node].set(p_alpha.astype(jnp.float32))
+    # XLA leaves the winner among duplicate scatter indices unspecified, so
+    # the min tree copies the sum tree's POST-scatter leaf values — both
+    # trees then agree on the same winner by construction (two independent
+    # scatters could record different priorities for the same slot, making
+    # min_tree report a phantom minimum).
+    m = trees.min_tree.at[node].set(s[node])
     for _ in range(_levels(cap)):
         node = node >> 1
         left = node << 1

@@ -25,7 +25,8 @@ from __future__ import annotations
 import numpy as np
 
 from d4pg_tpu.replay import device_per as dper
-from d4pg_tpu.replay.device_ring import DeviceStore, _bucket
+from d4pg_tpu.replay.device_ring import DeviceStore
+from d4pg_tpu.replay.segment_tree import next_pow2
 from d4pg_tpu.replay.uniform import TransitionBatch
 
 
@@ -58,7 +59,13 @@ class FusedDeviceReplay:
 
     # -- ingest side (any thread, under the service's buffer lock) ---------
     def add(self, batch: TransitionBatch) -> None:
-        """Stage host rows; cheap (no device work, no jit dispatch)."""
+        """Stage host rows; cheap (no device work, no jit dispatch).
+
+        Staging is bounded at ~ring capacity: if the learner pauses (long
+        eval, checkpoint) while actors keep streaming, the oldest staged
+        batches are dropped — they would only be overwritten by the next
+        drain anyway, and an unbounded backlog could otherwise OOM the
+        host (the host-buffer path is bounded at ring capacity too)."""
         n = batch.obs.shape[0]
         if n == 0:
             return
@@ -67,6 +74,9 @@ class FusedDeviceReplay:
         self._staged.append(
             TransitionBatch(*[np.asarray(v) for v in batch]))
         self._staged_rows += n
+        while (self._staged_rows - self._staged[0].obs.shape[0]
+               >= self.capacity):
+            self._staged_rows -= self._staged.pop(0).obs.shape[0]
 
     def __len__(self) -> int:
         # staged rows count toward warmup gates — they WILL be trained on
@@ -101,7 +111,7 @@ class FusedDeviceReplay:
         idx = ((self.head + np.arange(n)) % self.capacity).astype(np.int32)
         self._store.write(idx, batch)
         if self.trees is not None:
-            m = _bucket(n)
+            m = next_pow2(n)
             if m != n:
                 # pad by repeating live slots: duplicate writes of the same
                 # value are harmless to the trees (see device_per.insert)

@@ -114,31 +114,47 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         # guard: prefix just at/over the total can land on an unwritten leaf
         return np.minimum(idx, max(self.size - 1, 0))
 
-    def is_weights(self, idx: np.ndarray, beta: float) -> np.ndarray:
+    def weight_base(self) -> float:
+        """``z = (p_min / total) * N`` — the scalar whose ``z ** -beta`` is
+        the max IS weight. Multi-host sharded replay allgather-mins this
+        across hosts so every shard normalizes by the same global max
+        weight (per-host normalizers would scale gradient contributions
+        inconsistently across hosts)."""
+        total = self._trees.sum()
+        return float(self._trees.min() / total * self.size)
+
+    def is_weights(
+        self, idx: np.ndarray, beta: float,
+        weight_base: float | None = None,
+    ) -> np.ndarray:
         """(p_i * N)^-beta / max_weight, max via the min tree
-        (``prioritized_replay_memory.py:299-311``)."""
+        (``prioritized_replay_memory.py:299-311``). ``weight_base``
+        overrides the local ``z`` (see :meth:`weight_base`)."""
         assert beta > 0
         total = self._trees.sum()
-        p_min = self._trees.min() / total
-        max_weight = (p_min * self.size) ** (-beta)
+        z = self.weight_base() if weight_base is None else weight_base
+        max_weight = z ** (-beta)
         p = self._trees.get(idx) / total
         return ((p * self.size) ** (-beta) / max_weight).astype(np.float32)
 
     def sample(
-        self, batch_size: int, beta: float = 0.4
+        self, batch_size: int, beta: float = 0.4,
+        weight_base: float | None = None,
     ) -> tuple[TransitionBatch, np.ndarray, np.ndarray]:
         """Returns (batch, is_weights, idx); idx feeds update_priorities."""
         idx = self.sample_idx(batch_size)
-        return self.gather(idx), self.is_weights(idx, beta), idx
+        return self.gather(idx), self.is_weights(idx, beta, weight_base), idx
 
     def sample_chunk(
-        self, k: int, batch_size: int, beta: float = 0.4
+        self, k: int, batch_size: int, beta: float = 0.4,
+        weight_base: float | None = None,
     ) -> tuple[TransitionBatch, np.ndarray, np.ndarray]:
         """K stacked proportional samples in ONE storage gather: (batches
         [K, B, ...], weights [K, B], idx [K, B]). Tree walks and IS weights
         stay on the host; with device storage only the idx array crosses."""
         idx = np.stack([self.sample_idx(batch_size) for _ in range(k)])
-        w = np.stack([self.is_weights(idx[i], beta) for i in range(k)])
+        w = np.stack([self.is_weights(idx[i], beta, weight_base)
+                      for i in range(k)])
         return self.gather(idx), w.astype(np.float32), idx
 
     def update_priorities(

@@ -32,9 +32,6 @@ def next_pow2(n: int) -> int:
     return p
 
 
-_next_pow2 = next_pow2
-
-
 class _Tree:
     """Shared machinery; subclasses define the reduction."""
 
@@ -42,7 +39,7 @@ class _Tree:
     _op = None  # np ufunc
 
     def __init__(self, capacity: int):
-        self.capacity = _next_pow2(int(capacity))
+        self.capacity = next_pow2(int(capacity))
         self._levels = int(np.log2(self.capacity))
         self.tree = np.full(2 * self.capacity, self._neutral, np.float64)
 

@@ -28,20 +28,27 @@ class DeviceStager:
         sample_fn: Callable[[], object],
         device=None,
         with_aux: bool = False,
+        put_fn: Callable | None = None,
     ):
         self._sample = sample_fn
         self._device = device
         self._with_aux = with_aux
+        # Custom staging (e.g. multi-host: a host-local device_put cannot
+        # address other hosts' devices, so the multi-host runtime stages
+        # via jax.make_array_from_process_local_data instead —
+        # parallel/multihost.make_global_chunk).
+        self._put_fn = put_fn
         self._inflight = None
 
     def _put(self):
         sampled = self._sample()
         batch, aux = sampled if self._with_aux else (sampled, None)
-        staged = (
-            jax.device_put(batch, self._device)
-            if self._device is not None
-            else jax.device_put(batch)
-        )
+        if self._put_fn is not None:
+            staged = self._put_fn(batch)
+        elif self._device is not None:
+            staged = jax.device_put(batch, self._device)
+        else:
+            staged = jax.device_put(batch)
         return (staged, aux) if self._with_aux else staged
 
     def next(self, prefetch: bool = True):

@@ -206,7 +206,9 @@ def train(cfg: ExperimentConfig) -> dict:
             print(f"tensorboard disabled: {e}")
         bus.add_sink(CsvLogger(os.path.join(run_dir, "returns.csv"),
                                ["avg_test_reward", "ewma_test_reward"]))
-        ckpt = CheckpointManager(os.path.join(run_dir, "ckpt"))
+        ckpt = CheckpointManager(
+            os.path.join(run_dir, "ckpt"),
+            active_processes={0} if multi_host else None)
     extra: dict = {"env_steps": 0}
     if cfg.resume and multi_host:
         raise ValueError(
@@ -250,12 +252,18 @@ def train(cfg: ExperimentConfig) -> dict:
             actor = ActorWorker(f"actor-{w}", config, actor_cfg, pool, service,
                                 weights, seed=cfg.seed + w, obs_dtype=obs_dtype)
         actors.append(actor)
-    evaluator = Evaluator(config, make_env_fn(cfg, seed=cfg.seed + 777), weights,
-                          max_steps=cfg.max_steps, goal_conditioned=cfg.her)
+    # Process 0 owns eval (multi-host: other hosts' rollouts would only be
+    # discarded — their metrics bus has no sinks).
+    evaluator = (
+        Evaluator(config, make_env_fn(cfg, seed=cfg.seed + 777), weights,
+                  max_steps=cfg.max_steps, goal_conditioned=cfg.her)
+        if is_main else None
+    )
     # Concurrent eval (main.py:395-397: the reference's evaluator is a
     # separate process): greedy rollouts run on a background thread against
     # published weights; the learner never blocks on them.
-    async_eval = AsyncEvaluator(evaluator) if cfg.concurrent_eval else None
+    async_eval = (AsyncEvaluator(evaluator)
+                  if cfg.concurrent_eval and evaluator is not None else None)
 
     # --- warmup (main.py:200-207) ----------------------------------------
     warmup_ticks = max(1, cfg.warmup // max(1, cfg.num_envs))
@@ -396,12 +404,24 @@ def train(cfg: ExperimentConfig) -> dict:
         return {name: metrics[name][-1]
                 for name in ("critic_loss", "actor_loss", "q_mean")}
 
+    # Multi-host PER: all shards must normalize IS weights by the same
+    # global max weight — refreshed once per train_steps call (a tiny
+    # allgather; p_min drifts slowly within a cycle). None = local
+    # normalizer (single-host, exact reference semantics).
+    weight_base_cell: dict = {"z": None}
+
+    def _refresh_weight_base():
+        if multi_host and cfg.prioritized_replay:
+            weight_base_cell["z"] = multihost.global_min_scalar(
+                service.weight_base())
+
     def _sample_chunk():
         """One K-chunk: host tree walks pick [K, B] indices, ONE storage
         gather fetches the rows (device storage: rows stay in HBM)."""
         if cfg.prioritized_replay:
             batches, w, idx, gen = service.sample_chunk(
-                K, cfg.batch_size, beta=beta.value(lstep))
+                K, cfg.batch_size, beta=beta.value(lstep),
+                weight_base=weight_base_cell["z"])
             return (batches, w), (idx, gen)
         batches, _, _, _ = service.sample_chunk(K, cfg.batch_size)
         return (batches, None), None
@@ -422,6 +442,13 @@ def train(cfg: ExperimentConfig) -> dict:
             write_back=_per_write_back if cfg.prioritized_replay else None,
             sharding=chunk_sharding,
             use_weights=cfg.prioritized_replay,
+            # multi-host: stage chunks by assembling the global [K, B, ...]
+            # array from each process's local sample, and pull back only
+            # this host's td_error rows for its PER write-back
+            put_fn=((lambda payload: multihost.make_global_chunk(payload, mesh))
+                    if multi_host else None),
+            fetch_td=((lambda m: multihost.local_rows(m["td_error"], axis=1))
+                      if multi_host else None),
         )
         if K > 1 and not fused else None
     )
@@ -438,23 +465,35 @@ def train(cfg: ExperimentConfig) -> dict:
                  else jax.device_get(chunk_state.actor_params))
             weights.publish(p, step=lstep)  # bounded staleness: lag <= K
 
+    def _stage_single(batch):
+        """Place a host-local [B, ...] batch for the update: multi-host
+        assembles the global array from every process's local rows (a
+        host-local device_put cannot address other hosts' devices); a
+        single-host mesh device_puts with the data sharding."""
+        if multi_host:
+            return multihost.make_global_batch(batch, mesh)
+        if mesh is not None:
+            return shard_batch(batch, mesh)
+        return batch
+
     def train_single():
         nonlocal state, lstep
         if cfg.prioritized_replay:
-            batch, w, idx, gen = service.sample(cfg.batch_size,
-                                                beta=beta.value(lstep))
-            if mesh is not None:
-                batch = shard_batch(batch, mesh)
-                w = shard_batch(jnp.asarray(w), mesh)
-            state, metrics = update(state, batch, jnp.asarray(w))
+            batch, w, idx, gen = service.sample(
+                cfg.batch_size, beta=beta.value(lstep),
+                weight_base=weight_base_cell["z"])
+            batch = _stage_single(batch)
+            w = _stage_single(np.asarray(w, np.float32))
+            state, metrics = update(state, batch, w)
             lstep += 1
-            service.update_priorities(
-                idx, np.abs(np.asarray(metrics["td_error"])) + 1e-6,
-                generation=gen)
+            # each host writes back only ITS rows of the (possibly
+            # globally-sharded) td_error — they are the ones its local
+            # buffer sampled
+            td = (multihost.local_rows(metrics["td_error"], axis=0)
+                  if multi_host else np.asarray(metrics["td_error"]))
+            service.update_priorities(idx, np.abs(td) + 1e-6, generation=gen)
         else:
-            batch = service.sample(cfg.batch_size)
-            if mesh is not None:
-                batch = shard_batch(batch, mesh)
+            batch = _stage_single(service.sample(cfg.batch_size))
             state, metrics = update(state, batch)
             lstep += 1
         return metrics
@@ -464,6 +503,7 @@ def train(cfg: ExperimentConfig) -> dict:
         nonlocal state
         if fused:
             return train_steps_fused(n)
+        _refresh_weight_base()
         metrics = None
         n_chunks, remainder = (n // K, n % K) if K > 1 else (0, n)
         if n_chunks:
@@ -523,6 +563,10 @@ def train(cfg: ExperimentConfig) -> dict:
 
     timer = StepTimer()
     last_metrics: dict = {}
+    if multi_host:
+        # align the first sharded update across processes (warmup and
+        # io/eval setup take different time per role)
+        multihost.barrier("train_start")
     for epoch in range(cfg.n_epochs):
         for cycle in range(cfg.n_cycles):
             cycle_t0 = time.monotonic()
@@ -556,9 +600,11 @@ def train(cfg: ExperimentConfig) -> dict:
             if async_eval is not None:
                 async_eval.request(cfg.eval_trials, seed=eval_seed)
                 eval_metrics = async_eval.latest()
-            else:
+            elif evaluator is not None:
                 eval_metrics = evaluator.evaluate(cfg.eval_trials,
                                                   seed=eval_seed)
+            else:
+                eval_metrics = None
             last_metrics = {
                 "critic_loss": float(jax.device_get(metrics["critic_loss"])),
                 "actor_loss": float(jax.device_get(metrics["actor_loss"])),
@@ -581,7 +627,7 @@ def train(cfg: ExperimentConfig) -> dict:
             if cfg.async_actors:
                 supervise_actors()
             bus.log(lstep, last_metrics)
-            if (cycle + 1) % cfg.checkpoint_every == 0:
+            if ckpt is not None and (cycle + 1) % cfg.checkpoint_every == 0:
                 ckpt.save(
                     state if mesh is None else jax.device_get(state),
                     extra={"env_steps": service.env_steps},
@@ -602,7 +648,8 @@ def train(cfg: ExperimentConfig) -> dict:
                 "eval_lag_steps": lstep - final_eval["learner_step"],
             })
             bus.log(lstep, last_metrics)
-    ckpt.wait()
+    if ckpt is not None:
+        ckpt.wait()
     bus.close()
     for p in actor_processes:
         p.terminate()
@@ -618,6 +665,10 @@ def train(cfg: ExperimentConfig) -> dict:
             actor.env.close()
         else:
             actor.pool.close()
+    if multi_host:
+        # align exits: a process leaving while a peer still drains eval/
+        # checkpoints trips the jax.distributed shutdown barrier
+        multihost.barrier("train_end")
     return last_metrics
 
 
@@ -632,6 +683,10 @@ def main(argv=None):
 
         multihost.initialize(cfg.coordinator, cfg.num_processes,
                              cfg.process_id)
+        # create the collective context NOW, while processes are in
+        # lockstep (per-role io/eval setup later skews them past the
+        # context-init timeout)
+        multihost.barrier("startup")
         print(f"joined multi-host runtime: process {cfg.process_id}/"
               f"{cfg.num_processes}, {len(jax.devices())} global devices",
               flush=True)

@@ -188,6 +188,24 @@ def test_fused_buffer_drain_overflow_keeps_newest(rng):
     np.testing.assert_array_equal(got, np.arange(100 - CAP, 100))
 
 
+def test_fused_buffer_staging_is_bounded(rng):
+    """Ingest while the learner is paused must not grow without bound:
+    staged rows stay ~capacity (oldest dropped — the next drain would
+    overwrite them anyway), and drain still lands the newest rows."""
+    buf = FusedDeviceReplay(CAP, 1, 1, prioritized=False)
+    for i in range(20):  # 20 batches x 10 rows >> capacity 64
+        r = np.full((10, 1), float(i), np.float32)
+        buf.add(TransitionBatch(
+            obs=r, action=np.zeros((10, 1), np.float32), reward=r[:, 0],
+            next_obs=r, done=np.zeros(10, np.float32),
+            discount=np.ones(10, np.float32)))
+    assert buf._staged_rows <= CAP + 10
+    buf.drain()
+    assert buf.size == CAP
+    # the newest batches survived
+    assert float(np.asarray(buf.storage.reward).max()) == 19.0
+
+
 def test_train_fused_uniform_async(tmp_path):
     """End-to-end train() through the fused path with uniform replay and
     async actors (decoupled loop + remainder chunks: 18 = 8 + 8 + 2)."""

@@ -17,8 +17,7 @@ def _free_port() -> int:
     return port
 
 
-def test_two_processes_form_one_mesh():
-    port = _free_port()
+def _mh_env() -> dict:
     env = dict(os.environ)
     env.update({
         # stripped axon plugin + explicit CPU: robust even when the TPU
@@ -27,6 +26,49 @@ def test_two_processes_form_one_mesh():
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
     })
+    return env
+
+
+def test_two_process_full_train(tmp_path):
+    """The real train() CLI across two processes: PER chunk pipeline
+    (global [K, B] staging + local td write-back), the single-dispatch
+    remainder, per-cycle checkpointing (process 0 only owns io/ckpt/eval —
+    process 1 must not crash on the absent manager)."""
+    port = _free_port()
+    env = _mh_env()
+    args = [
+        "--env", "point", "--max_steps", "20", "--num_envs", "2",
+        "--warmup", "100", "--n_eps", "1", "--n_cycles", "2",
+        "--episodes_per_cycle", "1", "--train_steps_per_cycle", "18",
+        "--updates_per_dispatch", "8", "--eval_trials", "1",
+        "--bsize", "16", "--rmsize", "2000", "--n_atoms", "11",
+        "--v_min", "-5.0", "--v_max", "0.0",
+        "--log_dir", str(tmp_path),
+        "--coordinator", f"127.0.0.1:{port}", "--num_processes", "2",
+    ]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "d4pg_tpu.train", *args,
+             "--process_id", str(i)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=600)
+        outs.append(out)
+        assert p.returncode == 0, out[-3000:]
+    assert all("final:" in out for out in outs)
+    # eval/io belong to process 0 alone
+    assert "avg_test_reward" in outs[0]
+    assert "avg_test_reward" not in outs[1]
+
+
+def test_two_processes_form_one_mesh():
+    port = _free_port()
+    env = _mh_env()
     procs = [
         subprocess.Popen(
             [sys.executable, "-m", "d4pg_tpu.parallel.multihost_check",

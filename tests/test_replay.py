@@ -149,6 +149,27 @@ def test_per_is_weights_match_formula():
     assert w.max() == pytest.approx(1.0)
 
 
+def test_per_is_weights_global_base_override():
+    """Multi-host sharded replay normalizes all shards by one global
+    ``z = p_min_frac * N`` (allgather-min of the local bases): the
+    override must rescale weights by (z_local / z_global)^beta relative
+    to local normalization."""
+    buf = PrioritizedReplayBuffer(16, 1, 1, alpha=0.6)
+    idx = buf.add(make_batch(8, 1, 1))
+    buf.update_priorities(idx, np.arange(1.0, 9.0))
+    beta = 0.5
+    z_local = buf.weight_base()
+    w_local = buf.is_weights(idx, beta)
+    z_global = z_local / 4.0  # another shard holds a smaller min priority
+    w_global = buf.is_weights(idx, beta, weight_base=z_global)
+    np.testing.assert_allclose(
+        w_global, w_local * (z_global / z_local) ** beta, rtol=1e-5)
+    # and the override is what sample()/sample_chunk() thread through
+    _, w_s, i_s = buf.sample(8, beta=beta, weight_base=z_global)
+    np.testing.assert_allclose(
+        w_s, buf.is_weights(i_s, beta, weight_base=z_global), rtol=1e-6)
+
+
 def test_per_new_items_get_max_priority():
     buf = PrioritizedReplayBuffer(16, 1, 1, alpha=1.0)
     i1 = buf.add(make_batch(2, 1, 1))
