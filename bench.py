@@ -141,8 +141,8 @@ def bench_end_to_end(k: int = 16, capacity: int = 200_000,
     return n_dispatch * k / dt
 
 
-def bench_fused(k: int = 8, capacity: int = 200_000,
-                steps: int = 640) -> float:
+def bench_fused(k: int = 40, capacity: int = 200_000,
+                steps: int = 1600) -> float:
     """End-to-end learner rate through the FUSED path (the shipped default
     on device storage, ``learner/fused.py``): PER trees + transition ring
     both in HBM; stratified sample, gather, K-step update and priority

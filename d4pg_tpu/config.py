@@ -48,12 +48,17 @@ class ExperimentConfig:
     # priority staleness. 'auto' = on whenever storage resolves to device
     # and the learner is single-device; 'off' keeps host trees.
     fused_replay: str = "auto"
-    # K learner updates fused into one device dispatch via lax.scan
-    # (~16x single-dispatch throughput at K=16 on one chip; PER priority
-    # write-back then lags by <= 2K steps with the prefetch pipeline).
+    # K learner updates fused into one device dispatch via lax.scan.
+    # Dispatch latency dominates a tunneled/PCIe learner, so throughput
+    # scales ~linearly in K (fused path on one v5e chip: ~36k/~67k/~176k
+    # steps/sec at K=8/16/40 — bench.py's shipped-default measurement;
+    # run-to-run tunnel variance ~10%). 40 = one dispatch per HER-paper cycle
+    # (main.py:303-307's 40 train steps). On the fused path priorities
+    # still update per-step INSIDE the scan (zero staleness); the host
+    # pipeline's write-back lags <= 2K. Async weight staleness <= K.
     # Composes with data_parallel (batches sharded P(None, 'data')).
-    # 1 = exact reference semantics (write-back every step).
-    updates_per_dispatch: int = 8
+    # 1 = exact reference dispatch semantics (write-back every step).
+    updates_per_dispatch: int = 40
     # algorithm
     gamma: float = 0.99  # --gamma
     tau: float = 0.001  # --tau
