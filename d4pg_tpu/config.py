@@ -113,6 +113,16 @@ class ExperimentConfig:
     log_dir: str = "runs"  # --log_dir
     seed: int = 0
     checkpoint_every: int = 1  # cycles between checkpoints (main.py:367)
+    # Also checkpoint the replay buffer (contents + PER priorities) for
+    # EXACT elastic recovery — without it a resumed learner retrains from
+    # an empty buffer through a fresh warmup. Off by default: the payload
+    # is the whole ring (GBs at 1M Humanoid transitions).
+    checkpoint_replay: bool = False
+    # Ring payloads ride only every Nth checkpoint: the snapshot holds the
+    # buffer lock (stalling actor ingest) and for a device-resident ring
+    # pays a full D2H copy, so per-cycle would be pathological. A resume
+    # whose latest checkpoint lacks the payload just re-runs warmup.
+    checkpoint_replay_every: int = 10
     resume: bool = False
     debug: bool = False  # --debug
     # One-flag parity mode: the reference's own hyperparameters — v_min/
@@ -257,6 +267,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log_dir", default=d.log_dir)
     p.add_argument("--seed", type=int, default=d.seed)
     p.add_argument("--reward_scale", type=float, default=d.reward_scale)
+    _add_bool_flag(p, "checkpoint_replay", d.checkpoint_replay,
+                   "include the replay buffer in checkpoints")
+    p.add_argument("--checkpoint_replay_every", type=int,
+                   default=d.checkpoint_replay_every)
     _add_bool_flag(p, "resume", d.resume, "resume from latest checkpoint")
     _add_bool_flag(p, "debug", d.debug, "debug logging")
     _add_bool_flag(p, "strict_reference", d.strict_reference,
@@ -269,6 +283,7 @@ def parse_args(argv=None) -> ExperimentConfig:
     ns["her"] = bool(ns["her"])
     ns["prioritized_replay"] = bool(ns.pop("p_replay"))
     ns["resume"] = bool(ns["resume"])
+    ns["checkpoint_replay"] = bool(ns["checkpoint_replay"])
     ns["debug"] = bool(ns["debug"])
     ns["async_actors"] = bool(ns["async_actors"])
     ns["serve"] = bool(ns["serve"])

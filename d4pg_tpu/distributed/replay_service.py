@@ -134,6 +134,16 @@ class ReplayService:
         with self._buffer_lock:
             return drain()
 
+    def replay_state(self) -> dict:
+        """Buffer contents + priorities for checkpointing (learner
+        thread; SURVEY.md §5 elastic recovery)."""
+        with self._buffer_lock:
+            return self.buffer.state_dict()
+
+    def load_replay_state(self, d: dict) -> None:
+        with self._buffer_lock:
+            self.buffer.load_state_dict(d)
+
     @property
     def env_steps(self) -> int:
         with self._lock:

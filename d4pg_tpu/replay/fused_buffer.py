@@ -120,3 +120,40 @@ class FusedDeviceReplay:
         self.head = int((self.head + n) % self.capacity)
         self.size = int(min(self.size + n, self.capacity))
         return n
+
+    def state_dict(self) -> dict:
+        """Ring + tree state as host numpy for checkpointing. Learner
+        thread only (drains staged rows first so nothing is lost)."""
+        import jax
+
+        from d4pg_tpu.replay.uniform import pack_rows
+
+        self.drain()
+        rows = jax.device_get(
+            TransitionBatch(*[arr[:self.size] for arr in self.storage]))
+        d = pack_rows(rows, self.head, self.size, self.capacity)
+        if self.trees is not None:
+            cap = self.trees.capacity
+            d["leaf_priorities"] = np.asarray(
+                self.trees.sum_tree[cap:cap + self.size])
+            d["max_priority"] = float(self.trees.max_priority)
+        return d
+
+    def load_state_dict(self, d: dict) -> None:
+        import jax.numpy as jnp
+
+        from d4pg_tpu.replay.uniform import unpack_rows
+
+        batch, head, size = unpack_rows(d, self.capacity)
+        if batch is not None:
+            self._store.write(np.arange(size, dtype=np.int32), batch)
+        self.size = size
+        self.head = head
+        if self.trees is not None:
+            trees = dper.init(self.capacity)
+            if size:
+                trees = dper.set_leaves_jitted(
+                    trees, jnp.arange(size),
+                    jnp.asarray(d["leaf_priorities"], jnp.float32))
+            self.trees = trees._replace(
+                max_priority=jnp.float32(d.get("max_priority", 1.0)))

@@ -157,6 +157,24 @@ class PrioritizedReplayBuffer(ReplayBuffer):
                       for i in range(k)])
         return self.gather(idx), w.astype(np.float32), idx
 
+    def state_dict(self) -> dict:
+        d = super().state_dict()
+        # leaves already hold priority ** alpha; restore writes them back
+        # verbatim (only live slots — unwritten min-tree leaves must stay
+        # at the +inf neutral or p_min collapses to 0)
+        d["leaf_priorities"] = np.asarray(
+            self._trees.get(np.arange(self.size)))
+        d["max_priority"] = self.max_priority
+        d["generation"] = self.generation.copy()
+        return d
+
+    def load_state_dict(self, d: dict) -> None:
+        super().load_state_dict(d)
+        if self.size:
+            self._trees.set(np.arange(self.size), d["leaf_priorities"])
+        self.max_priority = float(d["max_priority"])
+        self.generation = np.asarray(d["generation"]).copy()
+
     def update_priorities(
         self,
         idx: np.ndarray,

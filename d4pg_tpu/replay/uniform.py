@@ -36,6 +36,36 @@ class TransitionBatch(NamedTuple):
     discount: np.ndarray  # [B] float32 = gamma^m * (1 - done)
 
 
+def pack_rows(rows: TransitionBatch, head: int, size: int,
+              capacity: int) -> dict:
+    """Checkpoint payload for ring contents — shared by the host buffers
+    and the fused device buffer so the restore guards live in one place."""
+    return {
+        "rows": {f: np.asarray(v) for f, v in
+                 zip(TransitionBatch._fields, rows)},
+        "head": head,
+        "size": size,
+        "capacity": capacity,
+    }
+
+
+def unpack_rows(d: dict, capacity: int):
+    """Validate + unpack a :func:`pack_rows` payload. Returns
+    ``(batch_or_None, head, size)``. Capacity must match exactly: a
+    wrapped ring re-laid into a different capacity leaves head/size
+    pointing at the wrong slots (live rows silently overwritten or
+    zero-garbage samples)."""
+    ckpt_cap = int(d.get("capacity", -1))
+    if ckpt_cap != capacity:
+        raise ValueError(
+            f"replay checkpoint capacity {ckpt_cap} != buffer capacity "
+            f"{capacity}; resume with the same --rmsize")
+    size = int(d["size"])
+    batch = (TransitionBatch(*[d["rows"][f] for f in TransitionBatch._fields])
+             if size else None)
+    return batch, int(d["head"]) % capacity, size
+
+
 class HostStore:
     """Preallocated contiguous numpy storage (the default)."""
 
@@ -149,3 +179,18 @@ class ReplayBuffer:
             raise ValueError("cannot sample from an empty buffer")
         idx = self._rng.choice(self.size, size=(k, batch_size), replace=True)
         return self.gather(idx), None, idx
+
+    def state_dict(self) -> dict:
+        """Buffer contents as host numpy for checkpointing (SURVEY.md §5
+        elastic recovery — the reference checkpoints nothing but net
+        weights, ``main.py:367-368``). Only the live rows are captured."""
+        return pack_rows(self.gather(np.arange(self.size)), self.head,
+                         self.size, self.capacity)
+
+    def load_state_dict(self, d: dict) -> None:
+        """Restore contents saved by :meth:`state_dict` (same capacity)."""
+        batch, head, size = unpack_rows(d, self.capacity)
+        if batch is not None:
+            self._store.write(np.arange(size), batch)
+        self.size = size
+        self.head = head

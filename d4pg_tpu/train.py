@@ -219,8 +219,13 @@ def train(cfg: ExperimentConfig) -> dict:
         if mesh is not None:
             state = replicate_state(state, mesh)
         service.set_env_steps(extra.get("env_steps", 0))
+        if extra.get("replay"):
+            # exact elastic recovery: buffer contents + PER priorities
+            # (resumed learners otherwise retrain from an empty buffer)
+            service.load_replay_state(extra.pop("replay"))
         print(f"resumed from step {int(state.step)} "
-              f"({service.env_steps} env steps)")
+              f"({service.env_steps} env steps, "
+              f"{len(service)} replay rows)")
 
     # --- actors + evaluator ----------------------------------------------
     weights = WeightStore()
@@ -265,15 +270,17 @@ def train(cfg: ExperimentConfig) -> dict:
     async_eval = (AsyncEvaluator(evaluator)
                   if cfg.concurrent_eval and evaluator is not None else None)
 
-    # --- warmup (main.py:200-207) ----------------------------------------
-    warmup_ticks = max(1, cfg.warmup // max(1, cfg.num_envs))
-    for actor in actors:
-        if cfg.her:
-            while actor.env_steps < cfg.warmup // cfg.n_workers:
-                actor.run_episode(cfg.max_steps)
-        else:
-            actor.run(warmup_ticks // cfg.n_workers)
-    service.flush()
+    # --- warmup (main.py:200-207); skipped when a restored replay
+    # checkpoint already covers it -----------------------------------------
+    if len(service) < cfg.warmup:
+        warmup_ticks = max(1, cfg.warmup // max(1, cfg.num_envs))
+        for actor in actors:
+            if cfg.her:
+                while actor.env_steps < cfg.warmup // cfg.n_workers:
+                    actor.run_episode(cfg.max_steps)
+            else:
+                actor.run(warmup_ticks // cfg.n_workers)
+        service.flush()
     print(f"warmup done: {len(service)} transitions")
 
     # --- optional network serving for remote actors (actor_main.py) ------
@@ -563,6 +570,7 @@ def train(cfg: ExperimentConfig) -> dict:
 
     timer = StepTimer()
     last_metrics: dict = {}
+    n_saves = 0
     if multi_host:
         # align the first sharded update across processes (warmup and
         # io/eval setup take different time per role)
@@ -628,9 +636,17 @@ def train(cfg: ExperimentConfig) -> dict:
                 supervise_actors()
             bus.log(lstep, last_metrics)
             if ckpt is not None and (cycle + 1) % cfg.checkpoint_every == 0:
+                n_saves += 1
+                extra_payload = {"env_steps": service.env_steps}
+                if (cfg.checkpoint_replay
+                        and n_saves % max(1, cfg.checkpoint_replay_every) == 0):
+                    # coarser cadence than the state checkpoint: the ring
+                    # snapshot holds the buffer lock and (device storage)
+                    # pays a full D2H copy
+                    extra_payload["replay"] = service.replay_state()
                 ckpt.save(
                     state if mesh is None else jax.device_get(state),
-                    extra={"env_steps": service.env_steps},
+                    extra=extra_payload,
                 )
     stop_actors.set()
     for t in actor_threads.values():

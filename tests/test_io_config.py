@@ -108,6 +108,71 @@ def test_checkpoint_roundtrip_and_resume(tmp_path, rng):
     mgr.close()
 
 
+def test_replay_state_roundtrip_host_and_per(rng):
+    """Replay checkpointing (SURVEY.md §5 elastic recovery): contents,
+    ring cursor, PER leaf priorities and max_priority all survive a
+    state_dict round trip — on the host buffer and the fused device
+    buffer alike."""
+    from d4pg_tpu.replay import PrioritizedReplayBuffer
+    from d4pg_tpu.replay.fused_buffer import FusedDeviceReplay
+
+    def fill(buf):
+        done = np.zeros(40, np.float32)
+        buf.add(TransitionBatch(
+            obs=rng.standard_normal((40, 3)).astype(np.float32),
+            action=rng.uniform(-1, 1, (40, 1)).astype(np.float32),
+            reward=np.arange(40, dtype=np.float32),
+            next_obs=rng.standard_normal((40, 3)).astype(np.float32),
+            done=done,
+            discount=np.full(40, 0.99, np.float32)))
+
+    src = PrioritizedReplayBuffer(64, 3, 1, alpha=0.6)
+    fill(src)
+    src.update_priorities(np.arange(10), np.linspace(1, 5, 10))
+    dst = PrioritizedReplayBuffer(64, 3, 1, alpha=0.6)
+    dst.load_state_dict(src.state_dict())
+    assert dst.size == src.size and dst.head == src.head
+    np.testing.assert_array_equal(dst.reward[:40], src.reward[:40])
+    np.testing.assert_allclose(dst._trees.get(np.arange(40)),
+                               src._trees.get(np.arange(40)))
+    assert dst.max_priority == src.max_priority
+    # min tree of unwritten slots stays neutral: sampling still works
+    assert np.isfinite(dst.is_weights(np.arange(5), 0.5)).all()
+
+    fsrc = FusedDeviceReplay(64, 3, 1, alpha=0.6)
+    fill(fsrc)
+    fsrc.drain()
+    fdst = FusedDeviceReplay(64, 3, 1, alpha=0.6)
+    fdst.load_state_dict(fsrc.state_dict())
+    assert fdst.size == 40 and fdst.head == fsrc.head
+    np.testing.assert_array_equal(np.asarray(fdst.storage.reward[:40]),
+                                  np.asarray(fsrc.storage.reward[:40]))
+    np.testing.assert_allclose(np.asarray(fdst.trees.sum_tree),
+                               np.asarray(fsrc.trees.sum_tree))
+
+
+def test_train_resume_with_replay(tmp_path):
+    """--checkpoint_replay 1 + --resume 1: the second run restores the
+    buffer (no re-warmup) and continues from the checkpointed step."""
+    from d4pg_tpu.train import train
+
+    common = dict(
+        env="point", max_steps=20, num_envs=2, warmup=100, n_epochs=1,
+        n_cycles=2, episodes_per_cycle=1, train_steps_per_cycle=4,
+        eval_trials=1, batch_size=16, memory_size=2000,
+        log_dir=str(tmp_path), hidden=(16, 16), n_atoms=11,
+        v_min=-5.0, v_max=0.0, checkpoint_replay=True,
+        checkpoint_replay_every=1,
+    )
+    m1 = train(ExperimentConfig(**common))
+    m2 = train(ExperimentConfig(**common, resume=True))
+    assert np.isfinite(m2["critic_loss"])
+    assert m2["env_steps"] > m1["env_steps"]
+    # the restored buffer skips the second warmup: only the two collect
+    # phases (~80 env steps) are added, not another ~100-step warmup
+    assert m2["env_steps"] - m1["env_steps"] < 100
+
+
 def test_checkpoint_restore_empty_dir(tmp_path):
     mgr = CheckpointManager(str(tmp_path / "empty"))
     config = D4PGConfig(obs_dim=3, act_dim=1, n_atoms=11, hidden=(8,))

@@ -81,3 +81,21 @@ def test_pixel_train_end_to_end(tmp_path):
     )
     metrics = train(cfg)
     assert np.isfinite(metrics["critic_loss"])
+
+
+def test_pixel_train_fused_device_replay(tmp_path):
+    """uint8 frames through the fused path: device ring stores uint8, the
+    in-scan gather feeds the conv encoder (which casts /255 itself), PER
+    trees update from pixel TD errors."""
+    from d4pg_tpu.train import train
+
+    cfg = ExperimentConfig(
+        env="pixel-point", max_steps=10, num_envs=2, warmup=60, n_epochs=1,
+        n_cycles=2, episodes_per_cycle=1, train_steps_per_cycle=4,
+        eval_trials=1, batch_size=8, memory_size=500,
+        log_dir=str(tmp_path), hidden=(16, 16), n_atoms=11,
+        v_min=-20.0, v_max=0.0, n_steps=1,
+        replay_storage="device", fused_replay="on",
+    )
+    metrics = train(cfg)
+    assert np.isfinite(metrics["critic_loss"])
