@@ -114,3 +114,142 @@ def make_fused_chunk(
         return state, metrics
 
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+
+def make_sharded_fused_chunk(
+    config: D4PGConfig,
+    mesh,
+    *,
+    k: int,
+    batch_size: int,
+    prioritized: bool = True,
+    alpha: float = 0.6,
+    beta0: float = 0.4,
+    beta_steps: int = 100_000,
+    donate: bool = True,
+):
+    """The fused chunk over a data-parallel mesh — the production
+    configuration with the replay data plane ON the mesh.
+
+    Storage/trees come from ``replay/sharded_per.ShardedFusedReplay``
+    (leading axis = shard, sharded over ``data``). Per step, a
+    ``shard_map`` prologue lets every device sample B/N rows from ITS
+    ring shard (stratified across shards by construction) and compute IS
+    weights with a GLOBAL max-weight normalizer (``lax.pmin`` over the
+    data axis — per-shard normalizers would bias gradient scale, the
+    same correction the multi-host host-tree path makes with its
+    allgather). The update itself is the ordinary ``update_step`` under
+    GSPMD: the batch emerges from the prologue already sharded
+    ``P('data')``, so the loss mean turns into the usual ICI all-reduce.
+    A second ``shard_map`` writes each shard's TD errors back into its
+    own trees. Batch rows never cross devices; only gradients do.
+
+    PER: ``fn(state, trees, storage, size) -> (state, trees, metrics)``;
+    uniform: ``fn(state, storage, size) -> (state, metrics)``. ``size``
+    is the per-shard live-row count [n_shards].
+    """
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from d4pg_tpu.parallel.mesh import DATA_AXIS
+    from d4pg_tpu.replay.sharded_per import ShardedPerTrees
+
+    n_shards = int(mesh.shape[DATA_AXIS])
+    if batch_size % n_shards:
+        raise ValueError(
+            f"batch_size {batch_size} not divisible by data axis {n_shards}")
+    b_local = batch_size // n_shards
+    Pd, Pr = P(DATA_AXIS), P()
+
+    def _local_trees(trees):
+        return dper.PerTrees(trees.sum_tree[0], trees.min_tree[0],
+                             trees.max_priority[0])
+
+    def _local_sample_per(trees, storage, size, key, beta):
+        ax = jax.lax.axis_index(DATA_AXIS)
+        t = _local_trees(trees)
+        idx = dper.sample(t, jax.random.fold_in(key, ax), b_local, size[0])
+        batch = TransitionBatch(*[arr[0][idx] for arr in storage])
+        # per-draw probability of row i: q_i = (1/N_shards) * p_i/total_h.
+        # The reference weight is (N_rows * q)^-beta / (N_rows * q_min)^-beta
+        # — N_rows cancels, so no psum of sizes is needed; only the global
+        # minimum per-draw probability crosses shards (one pmin scalar).
+        total = jnp.maximum(t.sum_tree[1], 1e-30)
+        q = t.sum_tree[t.capacity + idx] / total / n_shards
+        q_min = jax.lax.pmin(t.min_tree[1] / total / n_shards, DATA_AXIS)
+        w = (q / q_min) ** (-beta)
+        return batch, w.astype(jnp.float32), idx.astype(jnp.int32)
+
+    def _local_sample_uniform(storage, size, key):
+        ax = jax.lax.axis_index(DATA_AXIS)
+        idx = jax.random.randint(
+            jax.random.fold_in(key, ax), (b_local,), 0,
+            jnp.maximum(size[0], 1))
+        batch = TransitionBatch(*[arr[0][idx] for arr in storage])
+        return batch, idx.astype(jnp.int32)
+
+    def _local_write_back(trees, idx, td):
+        t = dper.update_from_td(_local_trees(trees), idx, td, alpha)
+        return ShardedPerTrees(t.sum_tree[None], t.min_tree[None],
+                               t.max_priority[None])
+
+    sample_per = shard_map(
+        _local_sample_per, mesh=mesh,
+        in_specs=(Pd, Pd, Pd, Pr, Pr), out_specs=(Pd, Pd, Pd),
+        check_vma=False)
+    sample_uniform = shard_map(
+        _local_sample_uniform, mesh=mesh,
+        in_specs=(Pd, Pd, Pr), out_specs=(Pd, Pd), check_vma=False)
+    write_back = shard_map(
+        _local_write_back, mesh=mesh,
+        in_specs=(Pd, Pd, Pd), out_specs=Pd, check_vma=False)
+
+    def chunk(state, trees, storage, size):
+        def body(carry, _):
+            state, trees = carry
+            k_sample, k_rest = jax.random.split(state.key)
+            state = state._replace(key=k_rest)
+            if prioritized:
+                beta = dper.beta_schedule(state.step, beta0, beta_steps)
+                batch, w, idx = sample_per(trees, storage, size,
+                                           k_sample, beta)
+            else:
+                batch, idx = sample_uniform(storage, size, k_sample)
+                w = None
+            state, metrics = update_step(config, state, batch, w)
+            if prioritized:
+                trees = write_back(trees, idx, metrics["td_error"])
+            metrics["idx"] = idx
+            return (state, trees), metrics
+
+        (state, trees), metrics = jax.lax.scan(
+            body, (state, trees), None, length=k)
+        return state, trees, metrics
+
+    repl = NamedSharding(mesh, Pr)
+    shard = NamedSharding(mesh, Pd)
+    out_metrics_shard = NamedSharding(mesh, P(None, DATA_AXIS))
+    out_metrics = {
+        "critic_loss": repl, "actor_loss": repl, "q_mean": repl,
+        "td_error": out_metrics_shard, "idx": out_metrics_shard,
+    }
+    if prioritized:
+        return jax.jit(
+            chunk,
+            in_shardings=(repl, shard, shard, shard),
+            out_shardings=(repl, shard, out_metrics),
+            donate_argnums=(0, 1) if donate else (),
+        )
+
+    def chunk_u(state, storage, size):
+        state, _, metrics = chunk(state, None, storage, size)
+        return state, metrics
+
+    return jax.jit(
+        chunk_u,
+        in_shardings=(repl, shard, shard),
+        out_shardings=(repl, {"critic_loss": repl, "actor_loss": repl,
+                              "q_mean": repl, "td_error": out_metrics_shard,
+                              "idx": out_metrics_shard}),
+        donate_argnums=(0,) if donate else (),
+    )

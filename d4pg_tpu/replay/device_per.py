@@ -74,29 +74,42 @@ def init(capacity: int) -> PerTrees:
 
 def set_leaves(trees: PerTrees, idx: Array, p_alpha: Array) -> PerTrees:
     """Write ``p_alpha`` ([B], already ``priority ** alpha``) at leaves
-    ``idx`` ([B] int) and repair both trees' ancestors."""
+    ``idx`` ([B] int) and repair both trees' ancestors.
+
+    Entries with ``idx >= capacity`` are PADS and are dropped entirely —
+    their scatter node is parked out of bounds through every repair level
+    (``mode='drop'`` discards the writes; the paired gathers clamp but
+    only feed dropped writes). Callers bucket batch sizes with such pads
+    for compile-count control; a pad-only call is a no-op."""
     cap = trees.capacity
-    node = idx.astype(jnp.int32) + cap
-    s = trees.sum_tree.at[node].set(p_alpha.astype(jnp.float32))
+    idx32 = idx.astype(jnp.int32)
+    valid = idx32 < cap
+    # pads park at 2*cap (one past the array): writes there are dropped;
+    # re-parked after every shift so they never alias a real node. (A
+    # shifted-high sentinel like (2*cap) << levels would overflow int32
+    # at realistic capacities — 2*cap^2 >= 2^41 for a 1M ring.)
+    node = jnp.where(valid, idx32 + cap, 2 * cap)
+    s = trees.sum_tree.at[node].set(p_alpha.astype(jnp.float32),
+                                    mode="drop")
     # XLA leaves the winner among duplicate scatter indices unspecified, so
     # the min tree copies the sum tree's POST-scatter leaf values — both
     # trees then agree on the same winner by construction (two independent
     # scatters could record different priorities for the same slot, making
     # min_tree report a phantom minimum).
-    m = trees.min_tree.at[node].set(s[node])
+    m = trees.min_tree.at[node].set(s[jnp.minimum(node, 2 * cap - 1)],
+                                    mode="drop")
     for _ in range(_levels(cap)):
-        node = node >> 1
-        left = node << 1
-        s = s.at[node].set(s[left] + s[left | 1])
-        m = m.at[node].set(jnp.minimum(m[left], m[left | 1]))
+        node = jnp.where(valid, node >> 1, 2 * cap)
+        left = jnp.minimum(node << 1, 2 * cap - 2)
+        s = s.at[node].set(s[left] + s[left | 1], mode="drop")
+        m = m.at[node].set(jnp.minimum(m[left], m[left | 1]), mode="drop")
     return PerTrees(s, m, trees.max_priority)
 
 
 def insert(trees: PerTrees, idx: Array, alpha: float) -> PerTrees:
     """New transitions enter with ``max_priority ** alpha``
-    (``prioritized_replay_memory.py:251-256``). Pad ``idx`` by repeating a
-    real slot — duplicate writes of the same value are harmless, so callers
-    can bucket sizes for compile-count control."""
+    (``prioritized_replay_memory.py:251-256``). Pad ``idx`` with
+    ``capacity`` (dropped) to bucket sizes for compile-count control."""
     p = jnp.full(idx.shape, trees.max_priority**alpha, jnp.float32)
     return set_leaves(trees, idx, p)
 

@@ -1,0 +1,294 @@
+"""Sharded device-resident replay: ring + PER trees distributed over the
+learner mesh's ``data`` axis.
+
+The multi-chip extension of the fused replay path (``device_ring.py`` /
+``device_per.py`` hold everything on ONE device). Here every device of
+the data axis owns a shard of the transition ring and its own PER
+sum/min tree pair; sampling, gathering and priority write-back run
+per-shard inside the sharded learner dispatch (``learner/fused.py``'s
+``make_sharded_fused_chunk``) — so the production configuration
+(K-step scan x data parallelism) keeps ZERO per-chunk host round trips
+and the batch rows never cross devices (each shard contributes
+``B / n_shards`` rows; only gradients ride the ICI collectives).
+
+This is the Ape-X sharded-replay layout made device-native. Sampling
+semantics: each shard draws B/N proportional samples from ITS shard
+(stratified across shards by construction); the importance weights
+correct for the true per-draw probability ``(1/N) * p_i / total_h``
+with a GLOBAL max-weight normalizer computed by ``lax.pmin`` over the
+data axis — reducing exactly to the reference formula
+(``prioritized_replay_memory.py:299-313``) at N=1.
+
+Host-side bookkeeping mirrors ``fused_buffer.FusedDeviceReplay``:
+``add`` stages rows (bounded), ``drain`` flushes at chunk boundaries on
+the learner thread (single owner of the donated device handles),
+splitting rows round-robin so shard sizes stay balanced.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from d4pg_tpu.replay.segment_tree import next_pow2
+from d4pg_tpu.replay.uniform import TransitionBatch, pack_rows, unpack_rows
+
+
+class ShardedPerTrees(NamedTuple):
+    """Per-shard tree pair, leading axis = shard (sharded over ``data``)."""
+
+    sum_tree: "jax.Array"  # [n_shards, 2 * cap_shard]
+    min_tree: "jax.Array"  # [n_shards, 2 * cap_shard]
+    max_priority: "jax.Array"  # [n_shards] per-shard running max
+
+    @property
+    def cap_shard(self) -> int:
+        return self.sum_tree.shape[1] // 2
+
+
+class ShardedFusedReplay:
+    """Device-sharded ring + trees for the mesh fused learner path."""
+
+    def __init__(
+        self,
+        capacity: int,
+        obs_dim: int | tuple,
+        act_dim: int,
+        mesh,
+        alpha: float = 0.6,
+        prioritized: bool = True,
+        obs_dtype=None,
+    ):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from d4pg_tpu.parallel.mesh import DATA_AXIS
+
+        self.mesh = mesh
+        self.n_shards = int(mesh.shape[DATA_AXIS])
+        # per-shard capacity, power of two for the tree layout
+        self.cap_shard = next_pow2(
+            max(1, int(np.ceil(capacity / self.n_shards))))
+        self.capacity = self.cap_shard * self.n_shards
+        obs_shape = (obs_dim,) if np.isscalar(obs_dim) else tuple(obs_dim)
+        if obs_dtype is None:
+            obs_dtype = np.float32 if len(obs_shape) == 1 else np.uint8
+        self.prioritized = bool(prioritized)
+        self.alpha = float(alpha)
+
+        shard = NamedSharding(mesh, P(DATA_AXIS))
+        n, c = self.n_shards, self.cap_shard
+        self.storage = jax.device_put(TransitionBatch(
+            obs=jnp.zeros((n, c, *obs_shape), obs_dtype),
+            action=jnp.zeros((n, c, act_dim), jnp.float32),
+            reward=jnp.zeros((n, c), jnp.float32),
+            next_obs=jnp.zeros((n, c, *obs_shape), obs_dtype),
+            done=jnp.zeros((n, c), jnp.float32),
+            discount=jnp.zeros((n, c), jnp.float32),
+        ), shard)
+        self.trees = (
+            jax.device_put(ShardedPerTrees(
+                sum_tree=jnp.zeros((n, 2 * c), jnp.float32),
+                min_tree=jnp.full((n, 2 * c), jnp.inf, jnp.float32),
+                max_priority=jnp.ones((n,), jnp.float32),
+            ), shard)
+            if prioritized else None
+        )
+        # per-shard ring cursors / live sizes (host ints; device twin of
+        # sizes is passed to the chunk as a [n_shards] array)
+        self._head = np.zeros(n, np.int64)
+        self._size = np.zeros(n, np.int64)
+        # round-robin cursor: which shard receives the next staged row
+        self._rr = 0
+        self._staged: list[TransitionBatch] = []
+        self._staged_rows = 0
+        self._insert_fn = None
+
+    # -- ingest side (drain thread, under the service's buffer lock) -------
+    def add(self, batch: TransitionBatch) -> None:
+        """Stage host rows; bounded at ~capacity like the single-device
+        fused buffer (oldest staged dropped — the next drain would
+        overwrite them anyway)."""
+        nrows = batch.obs.shape[0]
+        if nrows == 0:
+            return
+        if nrows > self.capacity:
+            raise ValueError(
+                f"batch of {nrows} exceeds capacity {self.capacity}")
+        self._staged.append(
+            TransitionBatch(*[np.asarray(v) for v in batch]))
+        self._staged_rows += nrows
+        while (self._staged_rows - self._staged[0].obs.shape[0]
+               >= self.capacity):
+            self._staged_rows -= self._staged.pop(0).obs.shape[0]
+
+    def __len__(self) -> int:
+        return int(min(self._size.sum() + self._staged_rows, self.capacity))
+
+    @property
+    def size(self):
+        """Per-shard live sizes [n_shards] (the chunk's ``size`` operand)."""
+        return self._size.astype(np.int32)
+
+    # -- learner side ------------------------------------------------------
+    def _make_insert(self):
+        """shard_map'd insert: each device scatters its rows into its ring
+        shard and stamps ``max_priority ** alpha`` into its trees. Pad
+        rows carry local idx == cap_shard, which both the ring scatter
+        (``mode='drop'``) and the tree write (``set_leaves``'s pad-drop
+        convention) discard."""
+        import jax
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from d4pg_tpu.parallel.mesh import DATA_AXIS
+        from d4pg_tpu.replay import device_per as dper
+
+        alpha = self.alpha
+
+        def local_insert(storage, trees, idx, rows):
+            # locals: storage [1, c, ...], trees [1, ...], idx [1, m],
+            # rows [1, m, ...]; pad entries carry idx == cap_shard and are
+            # dropped by both the ring scatter and the tree write
+            new_storage = TransitionBatch(*[
+                arr.at[0, idx[0]].set(v[0].astype(arr.dtype), mode="drop")
+                for arr, v in zip(storage, rows)
+            ])
+            if trees is None:
+                return new_storage, None
+            t = dper.PerTrees(trees.sum_tree[0], trees.min_tree[0],
+                              trees.max_priority[0])
+            t = dper.insert(t, idx[0], alpha)
+            return new_storage, ShardedPerTrees(
+                t.sum_tree[None], t.min_tree[None], t.max_priority[None])
+
+        specs = P(DATA_AXIS)
+        if self.trees is not None:
+            fn = shard_map(
+                local_insert, mesh=self.mesh,
+                in_specs=(specs, specs, specs, specs),
+                out_specs=(specs, specs), check_vma=False)
+            return jax.jit(fn, donate_argnums=(0, 1))
+        fn2 = shard_map(
+            lambda s, i, r: local_insert(s, None, i, r)[0],
+            mesh=self.mesh, in_specs=(specs, specs, specs),
+            out_specs=specs, check_vma=False)
+        return jax.jit(fn2, donate_argnums=(0,))
+
+    def drain(self) -> int:
+        """Flush staged rows round-robin across shards. Learner thread
+        only (single owner of the donated handles)."""
+        if not self._staged:
+            return 0
+        batch = (self._staged[0] if len(self._staged) == 1 else
+                 TransitionBatch(*[
+                     np.concatenate([np.asarray(b[f]) for b in self._staged])
+                     for f in range(len(self._staged[0]))]))
+        self._staged.clear()
+        self._staged_rows = 0
+        nrows = batch.obs.shape[0]
+        if nrows > self.capacity:
+            # keep exactly the newest `capacity` rows: a larger backlog
+            # would hand some shard more than cap_shard rows, i.e.
+            # duplicate slots in one scatter (unspecified winner)
+            batch = TransitionBatch(*[v[-self.capacity:] for v in batch])
+            nrows = self.capacity
+        n, cap = self.n_shards, self.cap_shard
+
+        # round-robin shard assignment, then per-shard local slots
+        shard_of = (self._rr + np.arange(nrows)) % n
+        self._rr = int((self._rr + nrows) % n)
+        m = next_pow2(int(np.ceil(nrows / n)))
+        local_idx = np.full((n, m), cap, np.int32)  # cap -> dropped pad
+        rows = TransitionBatch(*[
+            np.zeros((n, m, *np.asarray(v).shape[1:]), np.asarray(v).dtype)
+            for v in batch
+        ])
+        for s in range(n):
+            take = np.flatnonzero(shard_of == s)
+            cnt = len(take)
+            if cnt == 0:
+                continue
+            local_idx[s, :cnt] = (self._head[s] + np.arange(cnt)) % cap
+            for f in range(len(rows)):
+                rows[f][s, :cnt] = np.asarray(batch[f])[take]
+            self._head[s] = int((self._head[s] + cnt) % cap)
+            self._size[s] = int(min(self._size[s] + cnt, cap))
+
+        if self._insert_fn is None:
+            self._insert_fn = self._make_insert()
+        if self.trees is not None:
+            self.storage, self.trees = self._insert_fn(
+                self.storage, self.trees, local_idx, rows)
+        else:
+            self.storage = self._insert_fn(self.storage, local_idx, rows)
+        return nrows
+
+    # -- checkpointing -----------------------------------------------------
+    def state_dict(self) -> dict:
+        import jax
+
+        self.drain()
+        host = jax.device_get(self.storage)
+        d = pack_rows(
+            TransitionBatch(*[np.asarray(v) for v in host]),
+            0, 0, self.capacity)
+        d["sharded"] = {
+            "head": self._head.copy(),
+            "size": self._size.copy(),
+            "rr": self._rr,
+            "n_shards": self.n_shards,
+        }
+        if self.trees is not None:
+            t = jax.device_get(self.trees)
+            d["sharded"]["leaf_priorities"] = np.asarray(
+                t.sum_tree[:, self.cap_shard:])
+            d["sharded"]["max_priority"] = np.asarray(t.max_priority)
+        return d
+
+    def load_state_dict(self, d: dict) -> None:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from d4pg_tpu.parallel.mesh import DATA_AXIS
+
+        s = d.get("sharded")
+        if s is None or int(s["n_shards"]) != self.n_shards:
+            raise ValueError(
+                "sharded replay checkpoint requires the same data-parallel "
+                f"degree (got {s and s['n_shards']}, have {self.n_shards})")
+        _, _, _ = unpack_rows({**d, "size": 0, "head": 0}, self.capacity)
+        shard = NamedSharding(self.mesh, P(DATA_AXIS))
+        self.storage = jax.device_put(TransitionBatch(
+            *[jnp.asarray(d["rows"][f]) for f in TransitionBatch._fields]),
+            shard)
+        self._head = np.asarray(s["head"]).astype(np.int64).copy()
+        self._size = np.asarray(s["size"]).astype(np.int64).copy()
+        self._rr = int(s["rr"])
+        if self.trees is not None:
+            n, c = self.n_shards, self.cap_shard
+            leaves = np.asarray(s["leaf_priorities"], np.float32)
+            sum_tree = np.zeros((n, 2 * c), np.float32)
+            min_tree = np.full((n, 2 * c), np.inf, np.float32)
+            for sh in range(n):
+                sz = int(self._size[sh])
+                sum_tree[sh, c:c + sz] = leaves[sh, :sz]
+                min_tree[sh, c:c + sz] = leaves[sh, :sz]
+            # rebuild internal nodes level by level, vectorized across
+            # shards (a per-node Python loop would be ~1M iterations at
+            # production capacities)
+            lo = c
+            while lo > 1:
+                lo //= 2
+                kids_s = sum_tree[:, 2 * lo:4 * lo].reshape(n, -1, 2)
+                kids_m = min_tree[:, 2 * lo:4 * lo].reshape(n, -1, 2)
+                sum_tree[:, lo:2 * lo] = kids_s.sum(-1)
+                min_tree[:, lo:2 * lo] = kids_m.min(-1)
+            self.trees = jax.device_put(ShardedPerTrees(
+                sum_tree=jnp.asarray(sum_tree),
+                min_tree=jnp.asarray(min_tree),
+                max_priority=jnp.asarray(s["max_priority"], jnp.float32),
+            ), shard)

@@ -145,39 +145,58 @@ def train(cfg: ExperimentConfig) -> dict:
     # --- replay + schedule ------------------------------------------------
     storage = cfg.replay_storage
     if storage == "auto":
-        # Device-resident ring (replay/device_ring.py) when an accelerator
-        # is attached: per-dispatch H2D drops from O(batch bytes) to
-        # O(indices). Mesh path keeps rows on host (storage lives on one
-        # device); fall back when the ring wouldn't fit comfortably in HBM.
+        # Device-resident ring when an accelerator is attached:
+        # per-dispatch H2D drops from O(batch bytes) to O(indices) —
+        # single device (replay/device_ring.py) or sharded over the mesh's
+        # data axis (replay/sharded_per.py). Multi-host keeps rows on the
+        # host (per-host replay shards); fall back when the ring wouldn't
+        # fit comfortably in HBM.
         obs_elems = int(np.prod(obs_dim)) if not np.isscalar(obs_dim) else obs_dim
         ring_bytes = cfg.memory_size * (
             2 * obs_elems * np.dtype(obs_dtype).itemsize + (act_dim + 3) * 4)
         storage = (
             "device"
-            if jax.default_backend() != "cpu" and cfg.data_parallel == 1
+            if jax.default_backend() != "cpu"
             and not multi_host and ring_bytes < 8e9
+            # a sharded (mesh) learner can only use device storage through
+            # the fused path — 'auto' must resolve to host, not raise,
+            # when that path is disabled
+            and (cfg.fused_replay != "off" or cfg.data_parallel == 1)
             else "host"
         )
-    elif storage == "device" and (cfg.data_parallel > 1 or multi_host):
-        # The ring lives on ONE device; a sharded learner would re-pay the
-        # O(batch bytes) cross-device copy every dispatch (and fail outright
-        # on a multi-host mesh). Refuse instead of silently inverting the
-        # optimization.
+    elif storage == "device" and multi_host:
         raise ValueError(
-            "--replay_storage device is incompatible with --data_parallel > 1; "
-            "use 'host' (or 'auto', which resolves this automatically)")
+            "--replay_storage device is not supported on the multi-host "
+            "runtime (per-host replay shards stay in host RAM); use 'host' "
+            "or 'auto'")
     # Fully-fused replay+learn path (learner/fused.py): the PER trees join
     # the ring in HBM and the whole per-step replay protocol runs inside
     # the scanned dispatch — zero per-chunk host round trips, zero priority
     # staleness (at K=1 this IS the reference's exact per-step write-back,
-    # ddpg.py:252-255, executed on device).
-    fused = cfg.fused_replay != "off" and storage == "device" and mesh is None
+    # ddpg.py:252-255, executed on device). With a mesh the ring and trees
+    # shard over the data axis (each device samples its own B/N rows).
+    fused = (cfg.fused_replay != "off" and storage == "device"
+             and not multi_host)
     if cfg.fused_replay == "on" and not fused:
         raise ValueError(
             "--fused_replay on requires device replay storage on a "
-            "single-device learner (storage resolved to "
-            f"{storage!r}, data_parallel={cfg.data_parallel})")
-    if fused:
+            "single-host learner (storage resolved to "
+            f"{storage!r}, multi_host={multi_host})")
+    if storage == "device" and not fused:
+        # the non-fused device ring lives on ONE device; a sharded learner
+        # would re-pay the cross-device copy every dispatch
+        if mesh is not None:
+            raise ValueError(
+                "--replay_storage device with --data_parallel > 1 requires "
+                "the fused path (--fused_replay auto/on)")
+    if fused and mesh is not None:
+        from d4pg_tpu.replay.sharded_per import ShardedFusedReplay
+
+        buffer = ShardedFusedReplay(cfg.memory_size, obs_dim, act_dim, mesh,
+                                    alpha=cfg.per_alpha,
+                                    prioritized=cfg.prioritized_replay,
+                                    obs_dtype=obs_dtype)
+    elif fused:
         from d4pg_tpu.replay.fused_buffer import FusedDeviceReplay
 
         buffer = FusedDeviceReplay(cfg.memory_size, obs_dim, act_dim,
@@ -368,13 +387,19 @@ def train(cfg: ExperimentConfig) -> dict:
 
     def fused_for(k: int):
         if k not in fused_fns:
-            from d4pg_tpu.learner.fused import make_fused_chunk
+            from d4pg_tpu.learner.fused import (
+                make_fused_chunk,
+                make_sharded_fused_chunk,
+            )
 
-            fused_fns[k] = make_fused_chunk(
-                config, k=k, batch_size=cfg.batch_size,
+            kwargs = dict(
+                k=k, batch_size=cfg.batch_size,
                 prioritized=cfg.prioritized_replay, alpha=cfg.per_alpha,
                 beta0=cfg.per_beta0, beta_steps=cfg.per_beta_steps,
                 donate=True)
+            fused_fns[k] = (
+                make_sharded_fused_chunk(config, mesh, **kwargs)
+                if mesh is not None else make_fused_chunk(config, **kwargs))
         return fused_fns[k]
 
     # whole-tree on-device param copy in ONE dispatch (async publish below)

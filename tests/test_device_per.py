@@ -79,6 +79,42 @@ def test_is_weights_matches_host_formula(rng):
     np.testing.assert_allclose(got, expect, rtol=1e-4)
 
 
+def test_set_leaves_pads_are_dropped(rng):
+    """Entries with idx >= capacity are pads: a mixed batch only writes
+    its valid rows, and a pad-only call is a no-op (both trees, all
+    levels — the repair chain must not let parked pads alias real
+    nodes)."""
+    trees = dper.set_leaves(dper.init(CAP), jnp.arange(8),
+                            jnp.full(8, 2.0, jnp.float32))
+    mixed = dper.set_leaves(
+        trees, jnp.asarray([1, CAP, 3, CAP]),
+        jnp.asarray([5.0, 99.0, 7.0, 99.0], jnp.float32))
+    assert float(mixed.sum_tree[CAP + 1]) == 5.0
+    assert float(mixed.sum_tree[CAP + 3]) == 7.0
+    assert float(mixed.sum_tree[1]) == 2.0 * 6 + 5.0 + 7.0
+    assert float(mixed.min_tree[1]) == 2.0
+    pads_only = dper.set_leaves(
+        mixed, jnp.full(4, CAP), jnp.full(4, 123.0, jnp.float32))
+    np.testing.assert_array_equal(np.asarray(pads_only.sum_tree),
+                                  np.asarray(mixed.sum_tree))
+
+
+def test_set_leaves_traces_at_production_capacity():
+    """The pad sentinel must not overflow int32 at real buffer sizes
+    (1M-slot ring -> tree capacity 2^20): trace-only check."""
+    cap = 1 << 20
+    t = dper.PerTrees(
+        jax.ShapeDtypeStruct((2 * cap,), jnp.float32),
+        jax.ShapeDtypeStruct((2 * cap,), jnp.float32),
+        jax.ShapeDtypeStruct((), jnp.float32),
+    )
+    out = jax.eval_shape(
+        dper.set_leaves, t,
+        jax.ShapeDtypeStruct((256,), jnp.int32),
+        jax.ShapeDtypeStruct((256,), jnp.float32))
+    assert out.sum_tree.shape == (2 * cap,)
+
+
 def test_insert_and_update_semantics():
     trees = dper.init(CAP)
     alpha = 0.6
