@@ -55,7 +55,7 @@ class ExperimentConfig:
     # run-to-run tunnel variance ~10%). 40 = one dispatch per HER-paper cycle
     # (main.py:303-307's 40 train steps). On the fused path priorities
     # still update per-step INSIDE the scan (zero staleness); the host
-    # pipeline's write-back lags <= 2K. Async weight staleness <= K.
+    # pipeline's write-back lags <= (depth+1)K, default 3K. Async weight staleness <= K.
     # Composes with data_parallel (batches sharded P(None, 'data')).
     # 1 = exact reference dispatch semantics (write-back every step).
     updates_per_dispatch: int = 40

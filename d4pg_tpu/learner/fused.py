@@ -19,7 +19,7 @@ pure jnp inside one ``lax.scan``:
 so one dispatch carries K full steps with ZERO host round trips and ZERO
 priority staleness (fresher than the reference: within a chunk, step
 t+1's sampling distribution already reflects step t's TD errors — the
-host-pipelined path bounds staleness at ~2K instead). The host's only
+host-pipelined path bounds staleness at (depth+1)K instead). The host's only
 jobs left are draining actor transitions into the ring between chunks
 and fetching metrics when it wants them.
 """

@@ -8,12 +8,15 @@ nets, projection, optimizer, priorities). Schedule per chunk t:
   1. take the staged chunk t (sampled/device_put while t-1 computed),
      and immediately stage chunk t+1 (host work, overlaps device),
   2. dispatch the K-step scanned update for chunk t (async),
-  3. write back chunk t-1's PER priorities (blocks only on t-1's
-     td_error, which is ready or nearly so).
+  3. once more than ``depth`` chunks are in flight, write back the
+     oldest chunk's PER priorities (its td_error D2H copy was started at
+     dispatch time, so the flush rarely blocks).
 
-PER priorities therefore land with staleness <= 2K grad steps (Ape-X-style
-bounded lag); ``updates_per_dispatch=1`` in the config restores exact
-per-step write-back semantics via the non-pipelined path in ``train.py``.
+PER priorities therefore land with staleness <= (depth + 1) * K grad
+steps (Ape-X-style bounded lag); ``updates_per_dispatch=1`` in the config
+restores exact per-step write-back semantics via the non-pipelined path
+in ``train.py``. (The fused device path, ``learner/fused.py``, does not
+need any of this — its write-back happens inside the dispatch.)
 """
 
 from __future__ import annotations
@@ -43,6 +46,7 @@ class ChunkPipeline:
         use_weights: bool = True,
         fetch_td: Optional[Callable] = None,
         put_fn: Optional[Callable] = None,
+        depth: int = 2,
     ):
         self._update = update_fn
         self._write_back = write_back
@@ -55,6 +59,12 @@ class ChunkPipeline:
         # default is device_put onto ``sharding``.
         self._stager = DeviceStager(sample_fn, device=sharding,
                                     with_aux=True, put_fn=put_fn)
+        # In-flight dispatch depth: the PER write-back for chunk t blocks
+        # on t's td_error, i.e. on t's whole dispatch — on a high-latency
+        # (tunneled/PCIe) link that sync dominates. Keeping up to `depth`
+        # chunks in flight amortizes it; priority staleness grows to
+        # <= (depth + 1) * K steps (Ape-X-style bounded lag).
+        self._depth = max(1, int(depth))
 
     def invalidate(self) -> None:
         """Drop the staged chunk (sync-mode cycle boundary: train only on
@@ -74,7 +84,7 @@ class ChunkPipeline:
         ``final_prefetch=False`` when the caller will ``invalidate()``
         before the next run (avoids staging a chunk only to discard it)."""
         metrics = None
-        pending = None
+        pending: list = []
         for i in range(n_chunks):
             prefetch = final_prefetch or (i + 1 < n_chunks)
             (batches, w), aux = self._stager.next(prefetch=prefetch)
@@ -82,13 +92,18 @@ class ChunkPipeline:
                 state, metrics = self._update(state, batches, w)
             else:
                 state, metrics = self._update(state, batches)
-            if pending is not None:
-                self._flush(pending)
-            pending = (aux, metrics)
+            td = metrics.get("td_error") if self._write_back else None
+            if td is not None and getattr(td, "is_fully_addressable", False):
+                # start the D2H copy now; by flush time the bytes are
+                # already local and np.asarray doesn't pay the round trip
+                td.copy_to_host_async()
+            pending.append((aux, metrics))
+            while len(pending) > self._depth:
+                self._flush(pending.pop(0))
             if on_chunk is not None:
                 on_chunk(state)
-        if pending is not None:
-            self._flush(pending)
+        for p in pending:
+            self._flush(p)
         return state, metrics
 
     def _flush(self, pending) -> None:

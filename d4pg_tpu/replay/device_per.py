@@ -11,7 +11,7 @@ write-back — becomes pure ``jnp`` ops that fuse into the scanned learner
 update (``learner/fused.py``). One dispatch then carries K grad steps
 with zero host involvement and zero priority staleness (the reference
 writes priorities once per step, ``ddpg.py:252-255``; the host-pipelined
-chunk path bounds staleness by ~2K; this path restores exact per-step
+chunk path bounds staleness by (depth+1)K; this path restores exact per-step
 semantics *inside* the scan).
 
 Layout matches the host trees (``replay/segment_tree.py``): one flat

@@ -260,7 +260,8 @@ class ShardedFusedReplay:
             raise ValueError(
                 "sharded replay checkpoint requires the same data-parallel "
                 f"degree (got {s and s['n_shards']}, have {self.n_shards})")
-        _, _, _ = unpack_rows({**d, "size": 0, "head": 0}, self.capacity)
+        unpack_rows({k: v for k, v in d.items() if k != "sharded"}
+                    | {"size": 0, "head": 0}, self.capacity)
         shard = NamedSharding(self.mesh, P(DATA_AXIS))
         self.storage = jax.device_put(TransitionBatch(
             *[jnp.asarray(d["rows"][f]) for f in TransitionBatch._fields]),

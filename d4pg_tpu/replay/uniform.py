@@ -55,6 +55,10 @@ def unpack_rows(d: dict, capacity: int):
     wrapped ring re-laid into a different capacity leaves head/size
     pointing at the wrong slots (live rows silently overwritten or
     zero-garbage samples)."""
+    if "sharded" in d:
+        raise ValueError(
+            "replay checkpoint was saved by a sharded (data_parallel) "
+            "buffer; resume with the same --data_parallel degree")
     ckpt_cap = int(d.get("capacity", -1))
     if ckpt_cap != capacity:
         raise ValueError(

@@ -192,6 +192,11 @@ def train(cfg: ExperimentConfig) -> dict:
     if fused and mesh is not None:
         from d4pg_tpu.replay.sharded_per import ShardedFusedReplay
 
+        if cfg.batch_size % cfg.data_parallel:
+            # fail at startup, not after a whole warmup of rollouts
+            raise ValueError(
+                f"--bsize {cfg.batch_size} must divide by --data_parallel "
+                f"{cfg.data_parallel} for the sharded fused replay path")
         buffer = ShardedFusedReplay(cfg.memory_size, obs_dim, act_dim, mesh,
                                     alpha=cfg.per_alpha,
                                     prioritized=cfg.prioritized_replay,
@@ -460,7 +465,7 @@ def train(cfg: ExperimentConfig) -> dict:
 
     # Double-buffered host->device staging (SURVEY.md §7 "hard parts"):
     # while the device runs chunk t's scanned update, the host samples and
-    # device_puts chunk t+1; PER priority staleness is bounded by 2K steps.
+    # device_puts chunk t+1; PER priority staleness is bounded by (depth+1)K steps.
     # The pipeline itself lives in learner/pipeline.py, shared with bench.py
     # so the benchmarked loop IS the shipped loop.
     def _per_write_back(aux, td):

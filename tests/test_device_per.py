@@ -191,6 +191,22 @@ def test_fused_chunk_multi_step_advances_and_is_deterministic(rng):
     assert np.isfinite(float(m1["critic_loss"][-1]))
 
 
+def test_fused_chunk_mog_critic(rng):
+    """The fused chunk composes with the mixture-of-Gaussians critic (the
+    reference's empty stub, implemented for real): MoG TD errors feed the
+    in-scan priority write-back like the categorical path."""
+    config = D4PGConfig(obs_dim=4, act_dim=2, critic_family="mog",
+                        n_components=3, hidden=(16, 16), mog_samples=8)
+    state = init_state(config, jax.random.key(0))
+    storage = _fill_storage(rng, CAP, 4, 2)
+    trees = dper.insert(dper.init(CAP), jnp.arange(CAP), 0.6)
+    fn = make_fused_chunk(config, k=2, batch_size=8, donate=False)
+    s1, t1, m = fn(state, trees, storage, CAP)
+    assert int(s1.step) == 2
+    assert np.isfinite(np.asarray(m["critic_loss"])).all()
+    assert not np.allclose(np.asarray(t1.sum_tree), np.asarray(trees.sum_tree))
+
+
 def test_fused_chunk_uniform_variant(rng):
     config = D4PGConfig(obs_dim=4, act_dim=2, v_min=-10, v_max=10, n_atoms=11,
                         hidden=(16, 16, 16))

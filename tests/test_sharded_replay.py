@@ -187,6 +187,25 @@ def test_sharded_state_dict_roundtrip(rng):
                                   np.asarray(src.storage.reward))
 
 
+def test_sharded_checkpoint_rejected_by_flat_buffers(rng):
+    """A sharded replay checkpoint restored into a non-sharded buffer must
+    raise, not silently resume with an empty ring."""
+    from d4pg_tpu.replay import PrioritizedReplayBuffer
+    from d4pg_tpu.replay.fused_buffer import FusedDeviceReplay
+
+    src = ShardedFusedReplay(64, 4, 2, _mesh(4), alpha=0.6)
+    src.add(_batch(rng, 20))
+    src.drain()
+    d = src.state_dict()
+    with pytest.raises(ValueError, match="sharded"):
+        PrioritizedReplayBuffer(64, 4, 2).load_state_dict(d)
+    with pytest.raises(ValueError, match="sharded"):
+        FusedDeviceReplay(64, 4, 2).load_state_dict(d)
+    # and a different data-parallel degree is rejected too
+    with pytest.raises(ValueError, match="data-parallel"):
+        ShardedFusedReplay(64, 4, 2, _mesh(2)).load_state_dict(d)
+
+
 def test_train_sharded_fused_end_to_end(tmp_path):
     """train() with --data_parallel 4 + device replay: the fused data
     plane lives on the mesh (no more host-tree fallback for multi-chip)."""

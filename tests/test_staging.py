@@ -42,6 +42,37 @@ def test_stager_aux_rides_on_host():
     assert hasattr(p1["x"], "devices")
 
 
+def test_pipeline_depth_defers_but_never_drops_write_backs():
+    """ChunkPipeline keeps up to `depth` chunks in flight: write-backs for
+    early chunks are deferred (not yet flushed while the window fills) but
+    every chunk's priorities land exactly once by the end of run()."""
+    import jax.numpy as jnp
+
+    from d4pg_tpu.learner.pipeline import ChunkPipeline
+
+    n_sampled = {"n": 0}
+
+    def sample():
+        i = n_sampled["n"]
+        n_sampled["n"] += 1
+        return (np.full((2,), float(i), np.float32), None), ("aux", i)
+
+    def update(state, batch):
+        return state + 1, {"td_error": jnp.full((2,), float(np.asarray(batch)[0]))}
+
+    flushed = []
+    pipe = ChunkPipeline(update, sample,
+                         write_back=lambda aux, td: flushed.append(
+                             (aux[1], float(td[0]))),
+                         use_weights=False, depth=3)
+    state, _ = pipe.run(0, 8)
+    assert state == 8
+    # every chunk flushed exactly once, in order, with its own td
+    assert [f[0] for f in flushed] == list(range(8))
+    for i, td in flushed:
+        assert np.isclose(td, float(i) + 1e-6)
+
+
 def test_stager_invalidate_drops_inflight():
     counter = {"n": 0}
 
