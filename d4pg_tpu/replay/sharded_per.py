@@ -256,10 +256,15 @@ class ShardedFusedReplay:
         from d4pg_tpu.parallel.mesh import DATA_AXIS
 
         s = d.get("sharded")
-        if s is None or int(s["n_shards"]) != self.n_shards:
+        if s is None:
+            raise ValueError(
+                "replay checkpoint was saved by a non-sharded buffer; "
+                "resume with the same replay layout (data_parallel=1 or "
+                "host storage)")
+        if int(s["n_shards"]) != self.n_shards:
             raise ValueError(
                 "sharded replay checkpoint requires the same data-parallel "
-                f"degree (got {s and s['n_shards']}, have {self.n_shards})")
+                f"degree (got {s['n_shards']}, have {self.n_shards})")
         unpack_rows({k: v for k, v in d.items() if k != "sharded"}
                     | {"size": 0, "head": 0}, self.capacity)
         shard = NamedSharding(self.mesh, P(DATA_AXIS))
