@@ -17,6 +17,7 @@ from d4pg_tpu.learner.update import (
     make_update,
     update_step,
 )
+from d4pg_tpu.learner.fused import make_fused_chunk, make_sharded_fused_chunk
 
 __all__ = [
     "D4PGConfig",
@@ -28,4 +29,6 @@ __all__ = [
     "make_multi_update",
     "make_update",
     "update_step",
+    "make_fused_chunk",
+    "make_sharded_fused_chunk",
 ]

@@ -1,0 +1,29 @@
+"""Accelerator liveness probe for driver entry points.
+
+The tunnel to the chip can wedge in a way that makes ``jax.devices()``
+hang forever (observed on this image) — an in-process try/except cannot
+catch a hang, and a hung bench/dryrun costs the round its artifact. So
+the probe runs in a SUBPROCESS with a timeout, and also reports which
+platform actually resolved: ``jax.devices()`` succeeding proves nothing
+about an accelerator (JAX silently falls back to CPU), so callers must
+not label CPU-measured numbers as accelerator numbers.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+_CHILD = "import jax; print(jax.devices()[0].platform)"
+
+
+def accelerator_alive(timeout: float = 180.0) -> bool:
+    """True iff a NON-CPU backend initializes and answers within
+    ``timeout``. On False, callers force ``jax_platforms=cpu`` BEFORE any
+    backend-initializing call and record the fallback."""
+    try:
+        r = subprocess.run([sys.executable, "-c", _CHILD],
+                           timeout=timeout, capture_output=True, text=True)
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+    return r.returncode == 0 and r.stdout.strip().lower() != "cpu"

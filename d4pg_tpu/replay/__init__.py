@@ -1,9 +1,12 @@
-"""Host-side replay: storage, prioritization, n-step folding, device staging.
+"""Replay: storage, prioritization, n-step folding, staging — host or HBM.
 
-Replay lives in TPU-VM host RAM (preallocated numpy arrays, not the
-reference's Python tuple lists, ``replay_memory.py:14-19``), with vectorized
-segment trees for PER sampling and an async host->device staging pipeline so
-batch transfer hides under the XLA learner step.
+Three interchangeable data-plane tiers (docs/architecture.md): host numpy
+ring + vectorized/C++ segment trees (the reference-shaped layout,
+``replay_memory.py:14-19`` / ``prioritized_replay_memory.py``), a
+device-resident ring with host trees (``device_ring``), and fully
+device-resident ring + trees fused into the learner dispatch
+(``device_per``/``fused_buffer``; sharded over the mesh in
+``sharded_per``).
 """
 
 from d4pg_tpu.replay.schedule import LinearSchedule
@@ -12,6 +15,8 @@ from d4pg_tpu.replay.segment_tree import MinTree, SumTree
 from d4pg_tpu.replay.prioritized import PrioritizedReplayBuffer
 from d4pg_tpu.replay.nstep import NStepFolder
 from d4pg_tpu.replay.staging import DeviceStager
+from d4pg_tpu.replay.fused_buffer import FusedDeviceReplay
+from d4pg_tpu.replay.sharded_per import ShardedFusedReplay
 
 __all__ = [
     "LinearSchedule",
@@ -22,4 +27,6 @@ __all__ = [
     "PrioritizedReplayBuffer",
     "NStepFolder",
     "DeviceStager",
+    "FusedDeviceReplay",
+    "ShardedFusedReplay",
 ]

@@ -333,6 +333,24 @@ def test_train_fused_uniform_async(tmp_path):
     assert np.isfinite(metrics["critic_loss"])
 
 
+def test_train_fused_her_goal_env(tmp_path):
+    """HER relabels stream through the fused device buffer like ordinary
+    rows (goal-conditioned obs, success-based dones)."""
+    from d4pg_tpu.config import ExperimentConfig
+    from d4pg_tpu.train import train
+
+    cfg = ExperimentConfig(
+        env="fake-goal", her=True, max_steps=10, warmup=80, n_epochs=1,
+        n_cycles=2, episodes_per_cycle=2, train_steps_per_cycle=8,
+        eval_trials=1, batch_size=16, memory_size=2000,
+        log_dir=str(tmp_path), hidden=(16, 16), n_atoms=11,
+        v_min=-5.0, v_max=0.0, replay_storage="device", fused_replay="on",
+    )
+    metrics = train(cfg)
+    assert np.isfinite(metrics["critic_loss"])
+    assert "success_rate" in metrics
+
+
 def test_fused_buffer_stage_drain(rng):
     buf = FusedDeviceReplay(CAP, 4, 2, alpha=0.6)
     batch = TransitionBatch(
