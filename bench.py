@@ -29,7 +29,7 @@ import time
 import numpy as np
 
 
-from d4pg_tpu.probe import accelerator_alive
+from d4pg_tpu.probe import describe, ensure_backend
 
 BATCH = 256
 OBS_DIM, ACT_DIM = 376, 17  # Humanoid-v4 (BASELINE.md config #3)
@@ -255,11 +255,7 @@ def bench_reference_torch_cpu(steps: int = 20) -> float | None:
 
 
 def main():
-    fallback = not accelerator_alive()
-    if fallback:
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+    backend = ensure_backend(timeout=180.0)
     device_only = bench_tpu()
     fused = bench_fused()
     host_pipeline = bench_end_to_end()
@@ -273,10 +269,10 @@ def main():
         "host_pipeline_e2e": round(host_pipeline, 2),
         "baseline_torch_cpu": round(baseline, 2),
     }
-    if fallback:
-        out["note"] = ("accelerator unreachable (tunnel hang); measured on "
-                       "the CPU backend — TPU numbers are ~3 orders higher "
-                       "(see README Performance)")
+    if backend != "accel":
+        out["note"] = (f"{describe(backend)}; measured on the CPU backend — "
+                       "TPU numbers are ~3 orders higher (see README "
+                       "Performance)")
     print(json.dumps(out))
 
 

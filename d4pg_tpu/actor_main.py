@@ -64,6 +64,7 @@ def run_actor(
             epsilon_horizon=cfg.epsilon_horizon, n_step=cfg.n_steps,
             gamma=cfg.gamma, reward_scale=cfg.reward_scale, noise=cfg.noise,
             ou_theta=cfg.ou_theta, ou_sigma=cfg.ou_sigma, ou_mu=cfg.ou_mu,
+            device=cfg.actor_device,
         ),
         pool, RemoteReplayClient(sender), weights, seed=cfg.seed,
         obs_dtype=obs_dtype,
@@ -123,9 +124,18 @@ def main(argv=None):
     p.add_argument("--max_ticks", type=int, default=None)
     p.add_argument("--secret", default="",
                    help="shared secret matching the learner's --serve_secret")
+    p.add_argument("--actor_device", choices=("cpu", "default"), default="cpu")
     ns = p.parse_args(argv)
+    if ns.actor_device == "cpu":
+        # Acting runs on host CPU; force the platform BEFORE any jax call
+        # so even backend discovery never touches a (possibly wedged)
+        # accelerator plugin on this actor host.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     cfg = ExperimentConfig(env=ns.env, num_envs=ns.num_envs, n_steps=ns.n_steps,
-                           seed=ns.seed, noise=ns.noise)
+                           seed=ns.seed, noise=ns.noise,
+                           actor_device=ns.actor_device)
     steps = run_actor(cfg, ns.learner_host, ns.transitions_port,
                       ns.weights_port, ns.actor_id, ns.max_ticks,
                       secret=ns.secret or None)

@@ -80,6 +80,11 @@ class ExperimentConfig:
     ou_theta: float = 0.25  # --ou_theta (main.py:36, dead in reference)
     ou_sigma: float = 0.05  # --ou_sigma
     ou_mu: float = 0.0  # --ou_mu
+    # Backend for actor/evaluator inference: 'cpu' pins the per-tick policy
+    # forward to host CPU (the accelerator stays the learner's; a per-step
+    # device round trip costs more than the MLP forward), 'default' follows
+    # the default backend (see ActorConfig.device).
+    actor_device: str = "cpu"
     # loop shape (main.py:299-312)
     n_epochs: int = 20  # --n_eps
     n_cycles: int = 50
@@ -95,6 +100,13 @@ class ExperimentConfig:
     # train command with its own --process_id; process 0's host:port is
     # the coordinator. Empty coordinator = single-process (default).
     coordinator: str = ""
+    # Backend selection for the learner: 'auto' probes the accelerator in a
+    # subprocess (a wedged tunnel hangs backend init forever — observed on
+    # this image) and falls back to CPU; 'accel' skips the probe; 'cpu'
+    # forces the host backend. The probe runs on the CLI path only
+    # (train.main); programmatic train() callers get 'cpu' honored but no
+    # probing.
+    platform: str = "auto"
     num_processes: int = 1
     process_id: int = 0
     # Spawned local actor PROCESSES connecting through the TCP plane
@@ -241,6 +253,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ou_theta", type=float, default=d.ou_theta)
     p.add_argument("--ou_sigma", type=float, default=d.ou_sigma)
     p.add_argument("--ou_mu", type=float, default=d.ou_mu)
+    p.add_argument("--actor_device", choices=("cpu", "default"),
+                   default=d.actor_device)
     p.add_argument("--n_eps", type=int, default=d.n_epochs, dest="n_epochs")
     p.add_argument("--n_cycles", type=int, default=d.n_cycles)
     p.add_argument("--episodes_per_cycle", type=int, default=d.episodes_per_cycle)
@@ -252,6 +266,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n_workers", type=int, default=d.n_workers)
     p.add_argument("--actor_procs", type=int, default=d.actor_procs)
     p.add_argument("--coordinator", default=d.coordinator)
+    p.add_argument("--platform", choices=("auto", "accel", "cpu"),
+                   default=d.platform)
     p.add_argument("--num_processes", type=int, default=d.num_processes)
     p.add_argument("--process_id", type=int, default=d.process_id)
     p.add_argument("--data_parallel", type=int, default=d.data_parallel)

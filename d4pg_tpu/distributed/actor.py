@@ -15,6 +15,7 @@ learner (SURVEY.md §5 failure-detection note).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import threading
 
@@ -51,10 +52,49 @@ class ActorConfig:
     ou_sigma: float = 0.05
     ou_mu: float = 0.0
     ou_dt: float = 0.01
+    # Where actor inference runs. Acting is latency-bound batch-E inference
+    # dispatched every pool tick; on a TPU host every tick would round-trip
+    # PCIe (or a remote tunnel) for microseconds of MLP compute, serializing
+    # the env loop on transfer latency and contending with the learner's
+    # dispatch queue. 'cpu' (default) pins the policy forward to the host
+    # CPU backend — the D4PG production shape: the accelerator belongs to
+    # the learner, actors run on TPU-VM host cores. 'default' uses the
+    # default backend (worth it only for big conv encoders + wide pools).
+    device: str = "cpu"  # 'cpu' | 'default'
 
     def __post_init__(self):
         if self.noise not in ("gaussian", "ou"):
             raise ValueError(f"unknown noise process {self.noise!r}")
+        if self.device not in ("cpu", "default"):
+            raise ValueError(f"unknown actor device {self.device!r}")
+
+
+def resolve_act_device(kind: str):
+    """Pinned inference device for an acting/eval component: the host CPU
+    backend for ``'cpu'`` (see ``ActorConfig.device``), None (follow the
+    default backend) for ``'default'``. Shared by actors and the Evaluator
+    so the placement policy lives in one place."""
+    if kind not in ("cpu", "default"):
+        raise ValueError(f"unknown actor device {kind!r}")
+    return jax.devices("cpu")[0] if kind == "cpu" else None
+
+
+def act_device_scope(device):
+    """Thread-local default-device scope for a pinned device (no-op scope
+    when following the default backend)."""
+    if device is None:
+        return contextlib.nullcontext()
+    return jax.default_device(device)
+
+
+def put_params_on(device, params):
+    """Move published params onto the pinned device. Publishes may carry
+    accelerator arrays (the fused learner publishes device params);
+    committed arrays would drag the acting computation back onto the
+    learner's chip."""
+    if device is None:
+        return params
+    return jax.device_put(params, device)
 
 
 class _BaseActor:
@@ -74,7 +114,9 @@ class _BaseActor:
         self.cfg = actor_cfg
         self.service = service
         self.weights = weights
-        self._key = jax.random.key(seed)
+        self._act_device = resolve_act_device(actor_cfg.device)
+        with self._device_scope():
+            self._key = jax.random.key(seed)
         self._version = 0
         self._params = None
         self._epsilon = actor_cfg.epsilon_0
@@ -83,16 +125,27 @@ class _BaseActor:
         self._stop = threading.Event()
         self.env_steps = 0
 
+    def _device_scope(self):
+        """Context placing this actor's jax dispatches on its pinned device
+        (thread-local, so actor threads don't disturb the learner's default
+        placement). No-op scope when following the default backend."""
+        return act_device_scope(self._act_device)
+
     def _maybe_pull_weights(self) -> bool:
         got = self.weights.get_if_newer(self._version)
         if got is not None:
-            self._version, self._params = got
+            self._version, params = got
+            self._params = put_params_on(self._act_device, params)
             return True
         return False
 
     def _explore_actions(self, obs: np.ndarray) -> np.ndarray:
         """Noisy policy actions for a [B, obs_dim] batch; uniform random
         before the first weight publish (warmup, ``main.py:200-207``)."""
+        with self._device_scope():
+            return self._explore_actions_inner(obs)
+
+    def _explore_actions_inner(self, obs: np.ndarray) -> np.ndarray:
         self._key, ka = jax.random.split(self._key)
         if self._params is None:
             return np.asarray(
@@ -116,8 +169,9 @@ class _BaseActor:
         """Zero the OU state of envs whose episode ended
         (``random_process.py:41-45`` resets x on episode reset)."""
         if self._ou is not None and done_mask.any():
-            keep = jnp.asarray(~done_mask, jnp.float32)[:, None]
-            self._ou = self._ou._replace(x=self._ou.x * keep)
+            with self._device_scope():  # keep the OU state on the pinned device
+                keep = jnp.asarray(~done_mask, jnp.float32)[:, None]
+                self._ou = self._ou._replace(x=self._ou.x * keep)
 
     def _decay_epsilon(self) -> None:
         """eps = min + (eps0-min) * exp(-5k/horizon) on episode end — the

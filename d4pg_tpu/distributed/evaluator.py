@@ -21,6 +21,11 @@ import numpy as np
 from d4pg_tpu.envs.wrappers import flatten_goal_obs, rescale_action
 from d4pg_tpu.learner.state import D4PGConfig
 from d4pg_tpu.learner.update import act_deterministic
+from d4pg_tpu.distributed.actor import (
+    act_device_scope,
+    put_params_on,
+    resolve_act_device,
+)
 from d4pg_tpu.distributed.weights import WeightStore
 
 EWMA_OLD, EWMA_NEW = 0.95, 0.05  # main.py:131
@@ -34,6 +39,7 @@ class Evaluator:
         weights: WeightStore,
         max_steps: int = 1000,
         goal_conditioned: bool = False,
+        device: str = "cpu",
     ):
         self.config = config
         self.env = env_fn()
@@ -44,23 +50,32 @@ class Evaluator:
         low = np.asarray(self.env.action_space.low, np.float32)
         high = np.asarray(self.env.action_space.high, np.float32)
         self._low, self._high = low, high
+        # Greedy rollouts are batch-1 inference per env step — pinned to the
+        # host CPU backend by default for the same reason as ActorConfig
+        # .device: a per-step accelerator round trip costs more than the MLP
+        # forward, and eval must not contend with the learner's chip.
+        self._device = resolve_act_device(device)
+
+    def _device_scope(self):
+        return act_device_scope(self._device)
 
     def _greedy_episode(self, params, seed: int | None = None) -> tuple[float, bool]:
         reset_kw = {"seed": seed} if seed is not None else {}
         obs, _ = self.env.reset(**reset_kw)
         total, success = 0.0, False
-        for _ in range(self.max_steps):
-            flat = flatten_goal_obs(obs)
-            a = np.asarray(
-                act_deterministic(self.config, params, jnp.asarray(flat[None]))
-            )[0]
-            obs, r, term, trunc, info = self.env.step(
-                rescale_action(a, self._low, self._high)
-            )
-            total += float(r)
-            success = success or bool(info.get("is_success", False))
-            if term or trunc:
-                break
+        with self._device_scope():
+            for _ in range(self.max_steps):
+                flat = flatten_goal_obs(obs)
+                a = np.asarray(
+                    act_deterministic(self.config, params, jnp.asarray(flat[None]))
+                )[0]
+                obs, r, term, trunc, info = self.env.step(
+                    rescale_action(a, self._low, self._high)
+                )
+                total += float(r)
+                success = success or bool(info.get("is_success", False))
+                if term or trunc:
+                    break
         return total, success
 
     def evaluate(self, n_trials: int = 10, seed: int | None = None) -> dict:
@@ -72,6 +87,7 @@ class Evaluator:
         _, params, published_step = self.weights.snapshot()
         if params is None:
             raise RuntimeError("no weights published yet")
+        params = put_params_on(self._device, params)
         returns, successes = [], []
         for i in range(n_trials):
             ep_seed = None if seed is None else seed + i

@@ -1,0 +1,135 @@
+"""DM-Control suite adapter: pixel observations through the gymnasium API.
+
+BASELINE.md config #4 is "DM-Control cheetah-run from pixels (conv
+encoder)". The reference has no dm_control path at all (it is gym-only,
+``main.py:68``); this adapter exposes any ``dm_control.suite`` task as the
+same five-tuple gymnasium-style env the rest of the framework consumes
+(``EnvPool``, ``train.make_env_fn``), with:
+
+  - pixel observations rendered on the physics camera as [H, W, 3] uint8
+    (the shape ``train.infer_dims`` routes to the conv-encoder path), or
+    flattened state observations when ``pixels=False``;
+  - an action-repeat knob (standard for pixel control: the policy acts
+    every ``action_repeat`` physics control steps and rewards are summed),
+    keeping the effective episode length TPU-friendly;
+  - dm_control's time-limit end reported as gymnasium ``truncated`` (the
+    suite tasks never terminate early, so ``terminated`` is always False
+    and bootstrapping through the horizon is correct).
+
+Rendering needs an offscreen GL backend; EGL is the one present on this
+image, so it is defaulted here before MuJoCo loads (set ``MUJOCO_GL``
+yourself to override).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def _box(low, high, shape, dtype=np.float32):
+    from gymnasium.spaces import Box  # real gymnasium space: wrappers may
+    # read .dtype / .contains, which a hand-rolled shim would lack
+
+    return Box(
+        low=np.broadcast_to(np.asarray(low, dtype), shape),
+        high=np.broadcast_to(np.asarray(high, dtype), shape),
+        dtype=dtype,
+    )
+
+
+class DMControlEnv:
+    """One ``dm_control.suite`` task behind the gymnasium five-tuple API."""
+
+    def __init__(
+        self,
+        domain: str,
+        task: str,
+        pixels: bool = True,
+        height: int = 84,
+        width: int = 84,
+        camera_id: int = 0,
+        action_repeat: int = 4,
+        seed: int = 0,
+    ):
+        os.environ.setdefault("MUJOCO_GL", "egl")
+        from dm_control import suite  # lazy: only dmc envs pay the import
+
+        self._suite = suite
+        self._domain, self._task = domain, task
+        self._pixels = pixels
+        self._height, self._width, self._camera = height, width, camera_id
+        self._repeat = max(1, int(action_repeat))
+        self._env = suite.load(domain, task, task_kwargs={"random": seed})
+
+        spec = self._env.action_spec()
+        self.action_space = _box(spec.minimum, spec.maximum, spec.shape)
+        if pixels:
+            self.observation_space = _box(
+                0, 255, (height, width, 3), dtype=np.uint8
+            )
+        else:
+            dim = sum(
+                int(np.prod(v.shape)) if v.shape else 1
+                for v in self._env.observation_spec().values()
+            )
+            self.observation_space = _box(-np.inf, np.inf, (dim,))
+
+    def _obs(self, timestep):
+        if self._pixels:
+            return self._env.physics.render(
+                height=self._height, width=self._width, camera_id=self._camera
+            )
+        parts = [
+            np.atleast_1d(np.asarray(v, np.float32)).ravel()
+            for v in timestep.observation.values()
+        ]
+        return np.concatenate(parts).astype(np.float32)
+
+    def reset(self, seed=None, **kw):
+        if seed is not None:
+            # Re-seed IN PLACE: rebuilding via suite.load would leak the
+            # previous native physics (and EGL context on the pixel path)
+            # and recompile the MJCF — per seeded reset, i.e. per eval
+            # trial. dm_control tasks draw all episode randomness from
+            # task.random (dm_control.rl.control.Environment hands it to
+            # initialize_episode), so swapping the RandomState is the whole
+            # seeding story.
+            self._env.task._random = np.random.RandomState(seed)
+        ts = self._env.reset()
+        return self._obs(ts), {}
+
+    def step(self, action):
+        action = np.clip(
+            np.asarray(action, np.float32),
+            self.action_space.low,
+            self.action_space.high,
+        )
+        reward, ts = 0.0, None
+        for _ in range(self._repeat):
+            ts = self._env.step(action)
+            reward += float(ts.reward or 0.0)
+            if ts.last():
+                break
+        # suite tasks end only by time limit -> truncation, never termination
+        return self._obs(ts), reward, False, bool(ts.last()), {}
+
+    def close(self):
+        self._env.close()
+
+
+def parse_dmc_id(env_id: str):
+    """``'dmc:cheetah-run'`` / ``'dmc:cheetah-run-pixels'`` /
+    ``'cheetah-run-pixels'`` -> (domain, task, pixels) or None if the id is
+    not a dm_control spec."""
+    name = env_id[4:] if env_id.startswith("dmc:") else env_id
+    pixels = name.endswith("-pixels")
+    if pixels:
+        name = name[: -len("-pixels")]
+    elif not env_id.startswith("dmc:"):
+        return None
+    if "-" not in name:
+        return None
+    domain, task = name.split("-", 1)
+    return domain, task, pixels

@@ -36,7 +36,10 @@ PRESETS: dict[str, EnvPreset] = {
         "Pendulum-v1", v_min=-100.0, v_max=0.0, reward_scale=0.1, max_steps=200
     ),
     # BASELINE.md configs 2-5
-    "HalfCheetah-v4": EnvPreset("HalfCheetah-v4", v_min=0.0, v_max=1000.0),
+    # support reaches below zero: a random/early HalfCheetah policy earns
+    # negative discounted returns (~-100), which a [0, vmax] support would
+    # clip into the bottom atom and flatten early TD signal
+    "HalfCheetah-v4": EnvPreset("HalfCheetah-v4", v_min=-100.0, v_max=1000.0),
     "Humanoid-v4": EnvPreset("Humanoid-v4", v_min=0.0, v_max=800.0),
     "cheetah-run-pixels": EnvPreset(
         "cheetah-run-pixels", v_min=0.0, v_max=1000.0, pixels=True
@@ -44,9 +47,20 @@ PRESETS: dict[str, EnvPreset] = {
     "AdroitHandDoor-v1": EnvPreset(
         "AdroitHandDoor-v1", v_min=-100.0, v_max=300.0, goal_conditioned=False
     ),
-    # goal-conditioned sparse-reward family for the HER path
+    # goal-conditioned sparse-reward family for the HER path. Which version
+    # suffix is registered depends on the installed gymnasium-robotics
+    # (v2 on <=1.2, v4 on >=1.4 — the one on this image); both presets are
+    # kept so either id resolves.
     "FetchReach-v2": EnvPreset(
         "FetchReach-v2", v_min=-50.0, v_max=0.0, max_steps=50, n_step=1,
+        goal_conditioned=True,
+    ),
+    "FetchReach-v4": EnvPreset(
+        "FetchReach-v4", v_min=-50.0, v_max=0.0, max_steps=50, n_step=1,
+        goal_conditioned=True,
+    ),
+    "FetchPush-v4": EnvPreset(
+        "FetchPush-v4", v_min=-50.0, v_max=0.0, max_steps=50, n_step=1,
         goal_conditioned=True,
     ),
 }

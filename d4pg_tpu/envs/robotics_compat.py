@@ -1,0 +1,134 @@
+"""MuJoCo-3 compatibility for gymnasium-robotics Adroit / Shadow-Hand XMLs.
+
+BASELINE.md config #5 (Adroit/Shadow-Hand manipulation) ships MJCF files
+written for MuJoCo 2.x: they carry an ``<option apirate="...">`` attribute
+that the MuJoCo 3 schema rejects, so every ``gym.make`` of an Adroit/Hand
+env dies in XML parsing on this image. The attribute only ever controlled
+the remote-render API rate — it has no physics effect — so stripping it is
+semantics-preserving.
+
+:func:`install` hooks ``mujoco.MjModel.from_xml_path`` (the single loading
+funnel used by both gymnasium's ``MujocoEnv`` and gymnasium-robotics'
+``MujocoRobotEnv``): when a model file contains ``apirate``, the loader is
+redirected to a shadow copy of its directory in which every ``.xml`` has
+the attribute stripped and every other entry (mesh/texture dirs) is
+symlinked back to the original package assets. Clean files load through
+the original code path untouched.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+import tempfile
+
+_APIRATE = re.compile(rb'\s+apirate="[^"]*"')
+_shadow_dirs: dict[str, str] = {}
+_dir_needs_patch: dict[str, bool] = {}
+_installed = False
+
+
+def _needs_patch(src_dir: str) -> bool:
+    """True if any XML in ``src_dir`` carries apirate — the attribute can
+    live in an ``<include>``d sibling (adroit_assets.xml) rather than the
+    model file itself, so the whole directory is the unit of patching."""
+    cached = _dir_needs_patch.get(src_dir)
+    if cached is not None:
+        return cached
+    found = False
+    try:
+        for name in os.listdir(src_dir):
+            if name.endswith(".xml"):
+                with open(os.path.join(src_dir, name), "rb") as f:
+                    if b"apirate" in f.read():
+                        found = True
+                        break
+    except OSError:
+        found = False
+    _dir_needs_patch[src_dir] = found
+    return found
+
+
+def _assets_root(src_dir: str) -> str:
+    """Topmost ``assets`` ancestor of ``src_dir`` (the MJCF files reference
+    meshes through ``../``-relative paths that stay inside the package's
+    assets tree, so that tree is the unit of mirroring); ``src_dir`` itself
+    when no such ancestor exists."""
+    cur = src_dir
+    root = src_dir
+    while True:
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            break
+        if os.path.basename(cur) == "assets":
+            root = cur
+        cur = parent
+    return root
+
+
+def _shadow_dir(src_dir: str) -> str:
+    """Patched mirror of ``src_dir``: the whole assets tree is mirrored once
+    (XMLs copied with apirate stripped, meshes/textures symlinked), and the
+    corresponding shadow path for ``src_dir`` is returned. Idempotent, so a
+    partial mirror left by a crashed process just gets finished."""
+    cached = _shadow_dirs.get(src_dir)
+    if cached is not None:
+        return cached
+    root = _assets_root(src_dir)
+    tag = hashlib.sha256(root.encode()).hexdigest()[:16]
+    shadow_root = os.path.join(
+        tempfile.gettempdir(), f"d4pg-tpu-mjcf-compat-{tag}"
+    )
+    for cur, dirs, files in os.walk(root):
+        dst_cur = os.path.join(shadow_root, os.path.relpath(cur, root))
+        os.makedirs(dst_cur, exist_ok=True)
+        for name in files:
+            src_path = os.path.join(cur, name)
+            dst_path = os.path.join(dst_cur, name)
+            if os.path.lexists(dst_path):
+                # another process (--actor_procs spawns several, all
+                # mirroring the same shared /tmp tree at startup) already
+                # materialized this entry; package assets are immutable,
+                # so an existing file is always complete and current
+                continue
+            if name.endswith(".xml"):
+                with open(src_path, "rb") as f:
+                    data = _APIRATE.sub(b"", f.read())
+                # write-then-rename so concurrent readers never observe a
+                # truncated XML
+                tmp_path = f"{dst_path}.{os.getpid()}.tmp"
+                with open(tmp_path, "wb") as f:
+                    f.write(data)
+                os.replace(tmp_path, dst_path)
+            else:
+                try:
+                    os.symlink(src_path, dst_path)
+                except FileExistsError:
+                    pass  # lost the race to a concurrent mirror — fine
+    dst = os.path.normpath(
+        os.path.join(shadow_root, os.path.relpath(src_dir, root))
+    )
+    _shadow_dirs[src_dir] = dst
+    return dst
+
+
+def install() -> None:
+    """Idempotently hook ``MjModel.from_xml_path`` with the apirate shim."""
+    global _installed
+    if _installed:
+        return
+    import mujoco
+
+    orig = mujoco.MjModel.from_xml_path
+
+    def from_xml_path(xml_path, *args, **kwargs):
+        src_dir = os.path.dirname(os.path.abspath(xml_path))
+        if _needs_patch(src_dir):
+            xml_path = os.path.join(
+                _shadow_dir(src_dir), os.path.basename(xml_path)
+            )
+        return orig(xml_path, *args, **kwargs)
+
+    mujoco.MjModel.from_xml_path = staticmethod(from_xml_path)
+    _installed = True

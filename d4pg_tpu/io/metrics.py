@@ -25,6 +25,17 @@ class TensorBoardSink:
     """TensorBoard scalars, lazily importing the writer."""
 
     def __init__(self, log_dir: str):
+        # The writer only needs tensorboard's protobuf stub, but its lazy
+        # compat layer imports the FULL tensorflow package when present —
+        # which hard-segfaults in a process that already loaded MuJoCo's
+        # EGL stack (the dm_control pixel path). Registering the `notf`
+        # marker module makes tensorboard use its TF stub unconditionally.
+        import sys
+        import types
+
+        sys.modules.setdefault(
+            "tensorboard.compat.notf", types.ModuleType("tensorboard.compat.notf")
+        )
         from torch.utils.tensorboard import SummaryWriter  # baked-in torch
 
         self._writer = SummaryWriter(log_dir)

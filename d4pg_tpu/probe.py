@@ -7,23 +7,85 @@ the probe runs in a SUBPROCESS with a timeout, and also reports which
 platform actually resolved: ``jax.devices()`` succeeding proves nothing
 about an accelerator (JAX silently falls back to CPU), so callers must
 not label CPU-measured numbers as accelerator numbers.
+
+The probing entry points (``bench.py``, ``__graft_entry__``,
+``d4pg_tpu.train`` via ``--platform auto``) share :func:`ensure_backend`;
+``d4pg_tpu.actor_main`` instead forces CPU outright for its default
+``--actor_device cpu`` (no probe — with ``--actor_device default`` a
+wedged accelerator on the actor host will still hang backend init). The
+``D4PG_PLATFORM`` env var (``accel`` / ``cpu``) skips the probe for tight
+benchmark loops or forces the host backend outright.
 """
 
 from __future__ import annotations
 
+import os
 import subprocess
 import sys
 
 _CHILD = "import jax; print(jax.devices()[0].platform)"
 
 
-def accelerator_alive(timeout: float = 180.0) -> bool:
-    """True iff a NON-CPU backend initializes and answers within
-    ``timeout``. On False, callers force ``jax_platforms=cpu`` BEFORE any
-    backend-initializing call and record the fallback."""
+def probe_platform(timeout: float = 90.0) -> str:
+    """Resolve the default backend in a throwaway subprocess.
+
+    Returns ``'accel'`` (a non-CPU backend answered), ``'cpu'`` (backend
+    init succeeded but only CPU exists — an accelerator-less machine, not
+    a failure), or ``'dead'`` (init crashed or hung past ``timeout`` — the
+    wedged-tunnel case an in-process try/except cannot catch)."""
     try:
         r = subprocess.run([sys.executable, "-c", _CHILD],
                            timeout=timeout, capture_output=True, text=True)
     except (subprocess.TimeoutExpired, OSError):
-        return False
-    return r.returncode == 0 and r.stdout.strip().lower() != "cpu"
+        return "dead"
+    if r.returncode != 0:
+        return "dead"
+    return "cpu" if r.stdout.strip().lower() == "cpu" else "accel"
+
+
+def accelerator_alive(timeout: float = 180.0) -> bool:
+    """True iff a NON-CPU backend initializes and answers within
+    ``timeout``. On False, callers force ``jax_platforms=cpu`` BEFORE any
+    backend-initializing call and record the fallback."""
+    return probe_platform(timeout) == "accel"
+
+
+def ensure_backend(timeout: float = 90.0) -> str:
+    """Probe the default backend and force CPU when it is unusable.
+
+    The single fallback policy shared by every entry point. Returns
+      - ``'accel'``        — accelerator alive; default backend untouched,
+      - ``'cpu-absent'``   — no accelerator on this machine; CPU forced
+                             (so later init skips plugin discovery),
+      - ``'cpu-wedged'``   — backend init hung or crashed (wedged tunnel);
+                             CPU forced,
+      - ``'cpu-forced'``   — ``D4PG_PLATFORM=cpu`` requested CPU outright.
+    ``D4PG_PLATFORM=accel`` skips the probe (and its duplicate backend
+    init) for tight loops on known-healthy hardware.
+
+    Must run before any backend-initializing jax call in the process.
+    """
+    override = os.environ.get("D4PG_PLATFORM", "").lower()
+    if override == "accel":
+        return "accel"
+    import jax
+
+    if override == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu-forced"
+    status = probe_platform(timeout)
+    if status == "accel":
+        return "accel"
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu-absent" if status == "cpu" else "cpu-wedged"
+
+
+def describe(status: str) -> str:
+    """Human-readable reason for an :func:`ensure_backend` status — the one
+    phrasing every entry point logs/records."""
+    return {
+        "accel": "accelerator backend alive",
+        "cpu-wedged": "accelerator backend hung or crashed (wedged tunnel?)",
+        "cpu-absent": "no accelerator on this machine",
+        "cpu-forced": "CPU backend forced (D4PG_PLATFORM=cpu)",
+    }[status]

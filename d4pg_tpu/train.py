@@ -69,9 +69,31 @@ def make_env_fn(cfg: ExperimentConfig, seed: int):
         return lambda: FakeGoalEnv(horizon=cfg.max_steps, seed=seed)
     if cfg.env == "pixel-point":
         return lambda: PixelPointEnv(horizon=cfg.max_steps, seed=seed)
+    from d4pg_tpu.envs.dmc import DMControlEnv, parse_dmc_id
+
+    dmc = parse_dmc_id(cfg.env)
+    if dmc is not None:
+        domain, task, pixels = dmc
+        return lambda: DMControlEnv(domain, task, pixels=pixels, seed=seed)
     import gymnasium as gym
 
-    return lambda: gym.make(cfg.env)
+    def make():
+        try:
+            return gym.make(cfg.env)
+        except (gym.error.NameNotFound, gym.error.VersionNotFound):
+            # Fetch/Adroit/Shadow-Hand live in gymnasium_robotics, which
+            # registers its ids only once imported (BASELINE.md config #5).
+            # Their MuJoCo-2-era MJCF needs the apirate compat shim to load
+            # under MuJoCo 3 (envs/robotics_compat.py).
+            import gymnasium_robotics
+
+            from d4pg_tpu.envs.robotics_compat import install
+
+            install()
+            gym.register_envs(gymnasium_robotics)
+            return gym.make(cfg.env)
+
+    return make
 
 
 def infer_dims(cfg: ExperimentConfig) -> tuple[int | tuple, int, np.dtype]:
@@ -103,6 +125,12 @@ def infer_dims(cfg: ExperimentConfig) -> tuple[int | tuple, int, np.dtype]:
 
 def train(cfg: ExperimentConfig) -> dict:
     cfg = cfg.resolve()
+    if cfg.platform == "cpu":
+        # honor an explicit CPU request for programmatic callers too (the
+        # CLI path already forced it in main()); a no-op if the backend is
+        # already pinned. 'auto' probing stays CLI-only — a subprocess
+        # probe per train() call would tax every test/embedding caller.
+        jax.config.update("jax_platforms", "cpu")
     # Multi-host SPMD (parallel/multihost.py): every host runs this same
     # function with identical flags; host-side work (replay, actors) is
     # per-host, device work spans the global mesh. Process 0 owns io/eval.
@@ -262,7 +290,7 @@ def train(cfg: ExperimentConfig) -> dict:
         epsilon_horizon=cfg.epsilon_horizon, n_step=cfg.n_steps,
         gamma=cfg.gamma, reward_scale=cfg.reward_scale,
         noise=cfg.noise, ou_theta=cfg.ou_theta, ou_sigma=cfg.ou_sigma,
-        ou_mu=cfg.ou_mu,
+        ou_mu=cfg.ou_mu, device=cfg.actor_device,
     )
     actors = []
     for w in range(cfg.n_workers):
@@ -285,7 +313,8 @@ def train(cfg: ExperimentConfig) -> dict:
     # discarded — their metrics bus has no sinks).
     evaluator = (
         Evaluator(config, make_env_fn(cfg, seed=cfg.seed + 777), weights,
-                  max_steps=cfg.max_steps, goal_conditioned=cfg.her)
+                  max_steps=cfg.max_steps, goal_conditioned=cfg.her,
+                  device=cfg.actor_device)
         if is_main else None
     )
     # Concurrent eval (main.py:395-397: the reference's evaluator is a
@@ -720,6 +749,19 @@ def train(cfg: ExperimentConfig) -> dict:
 
 def main(argv=None):
     cfg = parse_args(argv)
+    if cfg.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    elif cfg.platform == "auto" and not cfg.coordinator:
+        # The tunnel to a remote accelerator can wedge so that backend init
+        # hangs forever (not raises — unkillable from in-process). Probe it
+        # in a subprocess with a timeout, exactly like the driver entry
+        # points (__graft_entry__.py), and fall back to CPU so a training
+        # run never hangs before its first log line.
+        from d4pg_tpu.probe import describe, ensure_backend
+
+        status = ensure_backend(timeout=90.0)
+        if status != "accel":
+            print(f"{describe(status)}; using the CPU backend", flush=True)
     if cfg.coordinator:
         # Join the multi-host runtime BEFORE any backend init; after this,
         # jax.devices() spans every process and --data_parallel can cover

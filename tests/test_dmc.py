@@ -1,0 +1,78 @@
+"""DM-Control adapter tests (BASELINE.md config #4 plumbing).
+
+Skipped wholesale when dm_control or an offscreen GL backend is missing —
+the adapter itself stays importable everywhere (lazy imports).
+"""
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.envs.dmc import DMControlEnv, parse_dmc_id
+
+
+def test_parse_dmc_id():
+    assert parse_dmc_id("cheetah-run-pixels") == ("cheetah", "run", True)
+    assert parse_dmc_id("dmc:cheetah-run-pixels") == ("cheetah", "run", True)
+    assert parse_dmc_id("dmc:cartpole-swingup") == ("cartpole", "swingup", False)
+    # dotted dm_control task names keep everything after the first dash
+    assert parse_dmc_id("dmc:ball_in_cup-catch") == ("ball_in_cup", "catch", False)
+    assert parse_dmc_id("Pendulum-v1") is None
+    assert parse_dmc_id("HalfCheetah-v4") is None
+    assert parse_dmc_id("point") is None
+
+
+def _dmc_available() -> bool:
+    try:
+        env = DMControlEnv("cartpole", "swingup", pixels=True, height=16,
+                           width=16, action_repeat=2, seed=0)
+        obs, _ = env.reset()
+        return obs.shape == (16, 16, 3)
+    except Exception:
+        return False
+
+
+pixels_ready = pytest.mark.skipif(
+    not _dmc_available(), reason="dm_control or offscreen GL unavailable"
+)
+
+
+@pixels_ready
+def test_dmc_pixel_env_contract():
+    env = DMControlEnv("cartpole", "swingup", pixels=True, height=16,
+                       width=16, action_repeat=2, seed=0)
+    obs, info = env.reset()
+    assert obs.dtype == np.uint8 and obs.shape == (16, 16, 3)
+    assert env.observation_space.shape == (16, 16, 3)
+    a = np.zeros(env.action_space.shape, np.float32)
+    obs2, r, term, trunc, _ = env.step(a)
+    assert obs2.shape == (16, 16, 3)
+    assert isinstance(r, float)
+    assert term is False  # suite tasks end by time limit only
+    env.close()
+
+
+@pixels_ready
+def test_dmc_state_env_contract():
+    env = DMControlEnv("cartpole", "swingup", pixels=False, seed=0)
+    obs, _ = env.reset()
+    assert obs.dtype == np.float32 and obs.ndim == 1
+    assert env.observation_space.shape == obs.shape
+    obs2, r, term, trunc, _ = env.step(
+        np.zeros(env.action_space.shape, np.float32)
+    )
+    assert obs2.shape == obs.shape
+    env.close()
+
+
+@pixels_ready
+def test_dmc_action_repeat_sums_reward():
+    e1 = DMControlEnv("cartpole", "swingup", pixels=False, action_repeat=1,
+                      seed=3)
+    e4 = DMControlEnv("cartpole", "swingup", pixels=False, action_repeat=4,
+                      seed=3)
+    e1.reset(seed=3)
+    e4.reset(seed=3)
+    a = np.zeros(e1.action_space.shape, np.float32)
+    r_sum = sum(e1.step(a)[1] for _ in range(4))
+    _, r4, *_ = e4.step(a)
+    np.testing.assert_allclose(r4, r_sum, rtol=1e-6)
