@@ -63,8 +63,8 @@ def run_actor(
             epsilon_0=cfg.epsilon_0, min_epsilon=cfg.min_epsilon,
             epsilon_horizon=cfg.epsilon_horizon, n_step=cfg.n_steps,
             gamma=cfg.gamma, reward_scale=cfg.reward_scale, noise=cfg.noise,
-            ou_theta=cfg.ou_theta, ou_sigma=cfg.ou_sigma, ou_mu=cfg.ou_mu,
-            device=cfg.actor_device,
+            random_eps=cfg.random_eps, ou_theta=cfg.ou_theta,
+            ou_sigma=cfg.ou_sigma, ou_mu=cfg.ou_mu, device=cfg.actor_device,
         ),
         pool, RemoteReplayClient(sender), weights, seed=cfg.seed,
         obs_dtype=obs_dtype,
@@ -121,6 +121,7 @@ def main(argv=None):
     p.add_argument("--n_steps", type=int, default=3)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--noise", choices=("gaussian", "ou"), default="gaussian")
+    p.add_argument("--random_eps", type=float, default=0.0)
     p.add_argument("--max_ticks", type=int, default=None)
     p.add_argument("--secret", default="",
                    help="shared secret matching the learner's --serve_secret")
@@ -135,6 +136,7 @@ def main(argv=None):
         jax.config.update("jax_platforms", "cpu")
     cfg = ExperimentConfig(env=ns.env, num_envs=ns.num_envs, n_steps=ns.n_steps,
                            seed=ns.seed, noise=ns.noise,
+                           random_eps=ns.random_eps,
                            actor_device=ns.actor_device)
     steps = run_actor(cfg, ns.learner_host, ns.transitions_port,
                       ns.weights_port, ns.actor_id, ns.max_ticks,

@@ -74,6 +74,9 @@ class ExperimentConfig:
     compute_dtype: str = "float32"  # 'bfloat16' for MXU-native matmuls
     # exploration
     noise: str = "gaussian"  # 'gaussian' | 'ou'
+    # per-tick probability of a uniform random action (HER-recipe
+    # epsilon-greedy; 0 = reference's additive-noise-only exploration)
+    random_eps: float = 0.0
     epsilon_0: float = 0.3  # random_process.py:11
     min_epsilon: float = 0.01
     epsilon_horizon: int = 5000
@@ -250,6 +253,7 @@ def build_parser() -> argparse.ArgumentParser:
                    default=d.compute_dtype)
     p.add_argument("--noise", choices=("gaussian", "ou"), default=d.noise)
     p.add_argument("--epsilon_0", type=float, default=d.epsilon_0)
+    p.add_argument("--random_eps", type=float, default=d.random_eps)
     p.add_argument("--ou_theta", type=float, default=d.ou_theta)
     p.add_argument("--ou_sigma", type=float, default=d.ou_sigma)
     p.add_argument("--ou_mu", type=float, default=d.ou_mu)

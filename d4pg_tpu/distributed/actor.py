@@ -48,6 +48,12 @@ class ActorConfig:
     # but never wires OU in (SURVEY.md C6 — constructed nowhere live); here
     # noise='ou' actually runs the temporally-correlated process.
     noise: str = "gaussian"  # 'gaussian' | 'ou'
+    # Probability of replacing the policy action with a uniform random one,
+    # per env per tick (the HER recipe's epsilon-greedy component — sparse
+    # goal tasks need undirected exploration that additive Gaussian noise
+    # around a confident wrong policy cannot provide). 0 = reference
+    # behavior (additive noise only, random_process.py:16-18).
+    random_eps: float = 0.0
     ou_theta: float = 0.25
     ou_sigma: float = 0.05
     ou_mu: float = 0.0
@@ -76,7 +82,13 @@ def resolve_act_device(kind: str):
     so the placement policy lives in one place."""
     if kind not in ("cpu", "default"):
         raise ValueError(f"unknown actor device {kind!r}")
-    return jax.devices("cpu")[0] if kind == "cpu" else None
+    if kind != "cpu":
+        return None
+    # local_devices, not devices: under jax.distributed the global device
+    # list starts with process 0's devices, so devices("cpu")[0] on any
+    # other process is NON-addressable and acting there either errors or
+    # produces arrays this process cannot read.
+    return jax.local_devices(backend="cpu")[0]
 
 
 def act_device_scope(device):
@@ -120,6 +132,7 @@ class _BaseActor:
         self._version = 0
         self._params = None
         self._epsilon = actor_cfg.epsilon_0
+        self._explore_rng = np.random.default_rng(seed + 17)
         self._episodes = 0
         self._ou = None  # lazily-sized OU state when cfg.noise == 'ou'
         self._stop = threading.Event()
@@ -160,10 +173,21 @@ class _BaseActor:
                 epsilon=self._epsilon, theta=self.cfg.ou_theta,
                 mu=self.cfg.ou_mu, sigma=self.cfg.ou_sigma, dt=self.cfg.ou_dt,
             )
-            return np.asarray(actions)
-        return np.asarray(
-            act(self.config, self._params, jnp.asarray(obs), ka, self._epsilon)
-        )
+            actions = np.asarray(actions)
+        else:
+            actions = np.asarray(
+                act(self.config, self._params, jnp.asarray(obs), ka,
+                    self._epsilon)
+            )
+        if self.cfg.random_eps > 0.0:
+            rng = self._explore_rng
+            mask = rng.random(actions.shape[0]) < self.cfg.random_eps
+            if mask.any():
+                actions = np.array(actions)  # jax->np output is read-only
+                actions[mask] = rng.uniform(
+                    -1.0, 1.0, (int(mask.sum()), actions.shape[1])
+                ).astype(actions.dtype)
+        return actions
 
     def _reset_noise(self, done_mask: np.ndarray) -> None:
         """Zero the OU state of envs whose episode ended
@@ -277,6 +301,14 @@ class GoalActorWorker(_BaseActor):
         # action, matching EnvPool/Evaluator.
         self._act_low = np.asarray(env.action_space.low, np.float32)
         self._act_high = np.asarray(env.action_space.high, np.float32)
+        # gymnasium 1.x wrappers (TimeLimit, OrderEnforcing) do NOT forward
+        # arbitrary attributes, so the GoalEnv's compute_reward (the
+        # ``main.py:177`` relabeling contract) must be taken from the
+        # unwrapped env when the handle is wrapped.
+        self._compute_reward = (
+            env.compute_reward if hasattr(env, "compute_reward")
+            else env.unwrapped.compute_reward
+        )
 
     def run_episode(self, max_steps: int) -> int:
         env = self.env
@@ -319,7 +351,7 @@ class GoalActorWorker(_BaseActor):
         self.service.add(originals, actor_id=self.actor_id)
         relabeled = her_relabel(
             raw_obs_a, np.stack(achieved), actions_a, next_raw_a,
-            env.compute_reward, self._np_rng, self.her_ratio, self.cfg.gamma,
+            self._compute_reward, self._np_rng, self.her_ratio, self.cfg.gamma,
         )
         relabeled = relabeled._replace(
             reward=relabeled.reward * self.cfg.reward_scale)

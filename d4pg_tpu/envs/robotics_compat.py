@@ -77,9 +77,20 @@ def _shadow_dir(src_dir: str) -> str:
         return cached
     root = _assets_root(src_dir)
     tag = hashlib.sha256(root.encode()).hexdigest()[:16]
+    # Per-uid, mode-0700, ownership-verified: the path is predictable, so
+    # on a multi-user host another user could otherwise pre-create it and
+    # have MuJoCo load attacker-controlled MJCF (existing entries are
+    # trusted and skipped below). Sharing WITHIN a uid is intentional —
+    # --actor_procs workers reuse one mirror.
     shadow_root = os.path.join(
-        tempfile.gettempdir(), f"d4pg-tpu-mjcf-compat-{tag}"
+        tempfile.gettempdir(), f"d4pg-tpu-mjcf-compat-{os.getuid()}-{tag}"
     )
+    os.makedirs(shadow_root, mode=0o700, exist_ok=True)
+    st = os.stat(shadow_root)
+    if st.st_uid != os.getuid():
+        # someone else owns the predictable path: fall back to a private
+        # unshared mirror rather than trusting their files
+        shadow_root = tempfile.mkdtemp(prefix="d4pg-tpu-mjcf-compat-")
     for cur, dirs, files in os.walk(root):
         dst_cur = os.path.join(shadow_root, os.path.relpath(cur, root))
         os.makedirs(dst_cur, exist_ok=True)

@@ -289,8 +289,8 @@ def train(cfg: ExperimentConfig) -> dict:
         epsilon_0=cfg.epsilon_0, min_epsilon=cfg.min_epsilon,
         epsilon_horizon=cfg.epsilon_horizon, n_step=cfg.n_steps,
         gamma=cfg.gamma, reward_scale=cfg.reward_scale,
-        noise=cfg.noise, ou_theta=cfg.ou_theta, ou_sigma=cfg.ou_sigma,
-        ou_mu=cfg.ou_mu, device=cfg.actor_device,
+        noise=cfg.noise, random_eps=cfg.random_eps, ou_theta=cfg.ou_theta,
+        ou_sigma=cfg.ou_sigma, ou_mu=cfg.ou_mu, device=cfg.actor_device,
     )
     actors = []
     for w in range(cfg.n_workers):

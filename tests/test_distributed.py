@@ -18,7 +18,7 @@ from d4pg_tpu.distributed import (
     TransitionSender,
     WeightStore,
 )
-from d4pg_tpu.distributed.actor import GoalActorWorker
+from d4pg_tpu.distributed.actor import GoalActorWorker, _BaseActor
 from d4pg_tpu.envs import EnvPool, FakeGoalEnv, PointMassEnv
 from d4pg_tpu.learner import D4PGConfig, init_state
 from d4pg_tpu.replay import PrioritizedReplayBuffer, ReplayBuffer
@@ -172,6 +172,69 @@ def test_goal_actor_her_streams_relabels():
     assert T > 0
     # originals + relabels: exactly 2T rows with her_ratio=1.0
     assert len(svc) == 2 * T
+    svc.close()
+
+
+def test_random_eps_exploration():
+    """random_eps=1 replaces every policy action with a uniform one (the
+    HER-recipe epsilon-greedy); 0 keeps pure policy+noise actions."""
+    config = D4PGConfig(obs_dim=4, act_dim=2, v_min=-5, v_max=0, n_atoms=11,
+                        hidden=(16, 16))
+    svc = ReplayService(ReplayBuffer(1000, 4, 2))
+    ws = WeightStore()
+    import jax as _jax
+
+    from d4pg_tpu.learner import init_state
+
+    ws.publish(init_state(config, _jax.random.key(0)).actor_params, step=0)
+    obs = np.zeros((64, 4), np.float32)
+
+    def actions_with(eps):
+        a = _BaseActor("a0", config, ActorConfig(random_eps=eps), svc, ws,
+                       seed=5)
+        a._maybe_pull_weights()
+        return a._explore_actions(obs)
+
+    pure = actions_with(0.0)
+    mixed = actions_with(1.0)
+    # identical obs rows -> identical policy actions up to noise draw;
+    # uniform replacement must decorrelate them from the pure run
+    assert not np.allclose(pure, mixed)
+    assert np.all(np.abs(mixed) <= 1.0)
+    svc.close()
+
+
+def test_goal_actor_on_wrapped_env():
+    """gymnasium 1.x wrappers do not forward attributes: compute_reward
+    must be resolved through env.unwrapped (regression: FetchReach-v4
+    under TimeLimit crashed the HER relabel with AttributeError)."""
+
+    class NonForwardingWrapper:
+        """Minimal gymnasium-1.x-style wrapper: exposes ONLY the core API
+        plus .unwrapped — no attribute forwarding."""
+
+        def __init__(self, env):
+            self.unwrapped = env
+            self.action_space = env.action_space
+            self.observation_space = env.observation_space
+
+        def reset(self, **kw):
+            return self.unwrapped.reset(**kw)
+
+        def step(self, a):
+            return self.unwrapped.step(a)
+
+    obs_dim = 2 + 2
+    config = D4PGConfig(obs_dim=obs_dim, act_dim=2, v_min=-50, v_max=0,
+                        n_atoms=11, hidden=(16, 16))
+    svc = ReplayService(ReplayBuffer(10_000, obs_dim, 2))
+    ws = WeightStore()
+    env = NonForwardingWrapper(FakeGoalEnv(horizon=30, seed=0))
+    actor = GoalActorWorker("g0", config, ActorConfig(gamma=0.98), env, svc,
+                            ws, her_ratio=1.0, rng_seed=2)
+    T = actor.run_episode(max_steps=30)
+    svc.flush()
+    assert T > 0 and len(svc) == 2 * T
     svc.close()
 
 
