@@ -77,6 +77,11 @@ class ExperimentConfig:
     # per-tick probability of a uniform random action (HER-recipe
     # epsilon-greedy; 0 = reference's additive-noise-only exploration)
     random_eps: float = 0.0
+    # Running observation standardization (envs/normalizer.py): actors
+    # store normalized rows, eval applies the same stats; off = reference
+    # behavior (no normalization anywhere). Vector obs only (the pixel
+    # encoder normalizes by /255). HER-recipe component for Fetch/Hand.
+    normalize_obs: bool = False
     epsilon_0: float = 0.3  # random_process.py:11
     min_epsilon: float = 0.01
     epsilon_horizon: int = 5000
@@ -254,6 +259,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--noise", choices=("gaussian", "ou"), default=d.noise)
     p.add_argument("--epsilon_0", type=float, default=d.epsilon_0)
     p.add_argument("--random_eps", type=float, default=d.random_eps)
+    _add_bool_flag(p, "normalize_obs", d.normalize_obs,
+                   "running observation standardization")
     p.add_argument("--ou_theta", type=float, default=d.ou_theta)
     p.add_argument("--ou_sigma", type=float, default=d.ou_sigma)
     p.add_argument("--ou_mu", type=float, default=d.ou_mu)
@@ -309,4 +316,5 @@ def parse_args(argv=None) -> ExperimentConfig:
     ns["serve"] = bool(ns["serve"])
     ns["concurrent_eval"] = bool(ns["concurrent_eval"])
     ns["strict_reference"] = bool(ns["strict_reference"])
+    ns["normalize_obs"] = bool(ns["normalize_obs"])
     return ExperimentConfig(**ns)

@@ -120,12 +120,17 @@ class _BaseActor:
         service: ReplayService,
         weights: WeightStore,
         seed: int = 0,
+        obs_norm=None,
     ):
         self.actor_id = actor_id
         self.config = config
         self.cfg = actor_cfg
         self.service = service
         self.weights = weights
+        # Shared RunningMeanStd (envs/normalizer.py) or None. Actors UPDATE
+        # it with fresh rows and store already-normalized observations, so
+        # the learner's jit'd update never sees raw scales.
+        self.obs_norm = obs_norm
         self._act_device = resolve_act_device(actor_cfg.device)
         with self._device_scope():
             self._key = jax.random.key(seed)
@@ -230,8 +235,10 @@ class ActorWorker(_BaseActor):
         weights: WeightStore,
         seed: int = 0,
         obs_dtype=None,
+        obs_norm=None,
     ):
-        super().__init__(actor_id, config, actor_cfg, service, weights, seed)
+        super().__init__(actor_id, config, actor_cfg, service, weights, seed,
+                         obs_norm=obs_norm)
         self.pool = pool
         self._folder = NStepFolder(
             actor_cfg.n_step, actor_cfg.gamma, pool.num_envs,
@@ -251,12 +258,23 @@ class ActorWorker(_BaseActor):
                 break
             if tick % self.cfg.weight_poll_every == 0:
                 self._maybe_pull_weights()
-            actions = self._explore_actions(obs)
+            if self.obs_norm is not None:
+                self.obs_norm.update(obs)
+                actions = self._explore_actions(self.obs_norm.normalize(obs))
+            else:
+                actions = self._explore_actions(obs)
             out = self.pool.step(actions)
             folded = self._folder.step(
                 obs, actions, out.reward * self.cfg.reward_scale,
                 out.final_obs, out.terminated, out.truncated,
             )
+            if self.obs_norm is not None and folded.obs.shape[0]:
+                # the n-step window holds RAW obs; rows leave for replay in
+                # normalized form (current statistics)
+                folded = folded._replace(
+                    obs=self.obs_norm.normalize(folded.obs),
+                    next_obs=self.obs_norm.normalize(folded.next_obs),
+                )
             self.service.add(folded, actor_id=self.actor_id)
             done_any = out.terminated | out.truncated
             self._reset_noise(done_any)
@@ -287,8 +305,10 @@ class GoalActorWorker(_BaseActor):
         her_ratio: float = 0.8,
         rng_seed: int = 0,
         seed: int = 0,
+        obs_norm=None,
     ):
-        super().__init__(actor_id, config, actor_cfg, service, weights, seed)
+        super().__init__(actor_id, config, actor_cfg, service, weights, seed,
+                         obs_norm=obs_norm)
         self.env = env
         self.her_ratio = her_ratio
         self._np_rng = np.random.default_rng(rng_seed)
@@ -318,6 +338,8 @@ class GoalActorWorker(_BaseActor):
         achieved.append(np.asarray(obs_dict["achieved_goal"], np.float32).copy())
         for _ in range(max_steps):
             flat = flatten_goal_obs(obs_dict)
+            if self.obs_norm is not None:
+                flat = self.obs_norm.normalize(flat)
             a = self._explore_actions(flat[None])[0]
             nobs_dict, r, term, trunc, info = env.step(
                 rescale_action(a, self._act_low, self._act_high)
@@ -348,13 +370,29 @@ class GoalActorWorker(_BaseActor):
             done=dones_a,
             discount=(self.cfg.gamma * (1.0 - dones_a)).astype(np.float32),
         )
-        self.service.add(originals, actor_id=self.actor_id)
         relabeled = her_relabel(
             raw_obs_a, np.stack(achieved), actions_a, next_raw_a,
             self._compute_reward, self._np_rng, self.her_ratio, self.cfg.gamma,
         )
         relabeled = relabeled._replace(
             reward=relabeled.reward * self.cfg.reward_scale)
+        if self.obs_norm is not None:
+            # statistics cover what the networks will train on — original
+            # AND relabeled rows (the HER paper normalizes goals too; the
+            # goal dims' stats here come from both desired and achieved
+            # goals) — then both batches are stored normalized. Relabeling
+            # above ran on RAW values: compute_reward needs true distances.
+            self.obs_norm.update(originals.obs)
+            self.obs_norm.update(relabeled.obs)
+            originals = originals._replace(
+                obs=self.obs_norm.normalize(originals.obs),
+                next_obs=self.obs_norm.normalize(originals.next_obs),
+            )
+            relabeled = relabeled._replace(
+                obs=self.obs_norm.normalize(relabeled.obs),
+                next_obs=self.obs_norm.normalize(relabeled.next_obs),
+            )
+        self.service.add(originals, actor_id=self.actor_id)
         # relabels are synthetic rows, not fresh env interaction: keep them
         # out of the env_steps counter (it is logged and checkpointed)
         self.service.add(relabeled, actor_id=self.actor_id,

@@ -40,12 +40,17 @@ class Evaluator:
         max_steps: int = 1000,
         goal_conditioned: bool = False,
         device: str = "cpu",
+        obs_norm=None,
     ):
         self.config = config
         self.env = env_fn()
         self.weights = weights
         self.max_steps = max_steps
         self.goal_conditioned = goal_conditioned
+        # shared RunningMeanStd: the policy was trained on normalized obs,
+        # so greedy eval must apply the same (current) statistics — read
+        # only, never updated from eval rollouts
+        self.obs_norm = obs_norm
         self.ewma_return: Optional[float] = None
         low = np.asarray(self.env.action_space.low, np.float32)
         high = np.asarray(self.env.action_space.high, np.float32)
@@ -66,6 +71,8 @@ class Evaluator:
         with self._device_scope():
             for _ in range(self.max_steps):
                 flat = flatten_goal_obs(obs)
+                if self.obs_norm is not None:
+                    flat = self.obs_norm.normalize(flat)
                 a = np.asarray(
                     act_deterministic(self.config, params, jnp.asarray(flat[None]))
                 )[0]

@@ -1,0 +1,88 @@
+"""Running observation normalization (the HER recipe's other half).
+
+The reference never normalizes observations — workable for Pendulum-scale
+state vectors, but goal-conditioned manipulation (Fetch/Hand, BASELINE.md
+config #5) mixes gripper positions (~1e-1 m), velocities and object poses
+whose scales differ by orders of magnitude; DDPG-family learners plateau
+without per-dimension standardization (the HER paper normalizes both obs
+and goals).
+
+Design for THIS framework's data plane: one host-side running estimator
+shared by every in-process actor and the evaluator. Actors update it with
+the rows they stream and store ALREADY-NORMALIZED observations in replay,
+so the jit'd learner update, the fused device path and the sharded data
+plane are untouched — normalization is a data-plane concern, not a model
+concern. Old replay rows keep the statistics they were written with
+(bounded drift, standard for replay normalizers à la VecNormalize); the
+estimator state rides the checkpoint ``extra`` payload for exact resume.
+
+Thread-safe: actor threads update concurrently with evaluator reads.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class RunningMeanStd:
+    """Numerically-stable streaming mean/variance (Chan et al. parallel
+    Welford merge), vectorized over feature dimensions."""
+
+    def __init__(self, dim: int, clip: float = 5.0, eps: float = 1e-2):
+        self.dim = int(dim)
+        self.clip = float(clip)
+        self.eps = float(eps)
+        self._lock = threading.Lock()
+        self._count = 0.0
+        self._mean = np.zeros(dim, np.float64)
+        self._m2 = np.zeros(dim, np.float64)
+
+    def update(self, batch: np.ndarray) -> None:
+        """Fold a [B, dim] batch into the running statistics."""
+        batch = np.asarray(batch, np.float64).reshape(-1, self.dim)
+        n = batch.shape[0]
+        if n == 0:
+            return
+        b_mean = batch.mean(axis=0)
+        b_m2 = ((batch - b_mean) ** 2).sum(axis=0)
+        with self._lock:
+            total = self._count + n
+            delta = b_mean - self._mean
+            self._mean = self._mean + delta * (n / total)
+            self._m2 = self._m2 + b_m2 + delta**2 * (self._count * n / total)
+            self._count = total
+
+    def stats(self) -> tuple[np.ndarray, np.ndarray]:
+        """(mean, std) snapshot; std is floored at ``eps`` (HER paper) so
+        constant dimensions don't blow up."""
+        with self._lock:
+            mean = self._mean.copy()
+            var = (self._m2 / self._count) if self._count > 0 else np.ones_like(self._m2)
+        return mean, np.sqrt(np.maximum(var, self.eps**2))
+
+    def normalize(self, x: np.ndarray) -> np.ndarray:
+        """Standardize and clip to ±clip; returns float32."""
+        mean, std = self.stats()
+        out = (np.asarray(x, np.float64) - mean) / std
+        return np.clip(out, -self.clip, self.clip).astype(np.float32)
+
+    # -- checkpoint payload -------------------------------------------------
+    def state_dict(self) -> dict:
+        with self._lock:
+            return {
+                "count": float(self._count),
+                "mean": self._mean.copy(),
+                "m2": self._m2.copy(),
+                "clip": self.clip,
+                "eps": self.eps,
+            }
+
+    def load_state_dict(self, d: dict) -> None:
+        with self._lock:
+            self._count = float(d["count"])
+            self._mean = np.asarray(d["mean"], np.float64).copy()
+            self._m2 = np.asarray(d["m2"], np.float64).copy()
+            self.clip = float(d.get("clip", self.clip))
+            self.eps = float(d.get("eps", self.eps))

@@ -21,6 +21,7 @@ from __future__ import annotations
 import hashlib
 import os
 import re
+import stat
 import tempfile
 
 _APIRATE = re.compile(rb'\s+apirate="[^"]*"')
@@ -86,10 +87,11 @@ def _shadow_dir(src_dir: str) -> str:
         tempfile.gettempdir(), f"d4pg-tpu-mjcf-compat-{os.getuid()}-{tag}"
     )
     os.makedirs(shadow_root, mode=0o700, exist_ok=True)
-    st = os.stat(shadow_root)
-    if st.st_uid != os.getuid():
-        # someone else owns the predictable path: fall back to a private
-        # unshared mirror rather than trusting their files
+    st = os.lstat(shadow_root)  # lstat: a planted symlink must not pass by
+    # pointing at a directory the victim owns
+    if st.st_uid != os.getuid() or not stat.S_ISDIR(st.st_mode):
+        # someone else owns (or symlinked) the predictable path: fall back
+        # to a private unshared mirror rather than trusting its contents
         shadow_root = tempfile.mkdtemp(prefix="d4pg-tpu-mjcf-compat-")
     for cur, dirs, files in os.walk(root):
         dst_cur = os.path.join(shadow_root, os.path.relpath(cur, root))

@@ -280,6 +280,33 @@ def train(cfg: ExperimentConfig) -> dict:
               f"{len(service)} replay rows)")
 
     # --- actors + evaluator ----------------------------------------------
+    obs_norm = None
+    if cfg.normalize_obs:
+        if config.pixels:
+            raise ValueError("--normalize_obs is for vector observations; "
+                             "the pixel encoder already normalizes by /255")
+        if cfg.actor_procs or cfg.serve:
+            # spawned/remote actors have no handle on this process's
+            # statistics yet; mixing their raw rows with in-process
+            # normalized rows would silently corrupt training
+            raise ValueError("--normalize_obs currently requires in-process "
+                             "actors (no --actor_procs / --serve)")
+        from d4pg_tpu.envs.normalizer import RunningMeanStd
+
+        obs_norm = RunningMeanStd(config.obs_dim)
+        if extra.get("obs_norm"):
+            # resume with the statistics the stored replay rows (and the
+            # restored policy) were normalized with
+            obs_norm.load_state_dict(extra.pop("obs_norm"))
+        elif cfg.resume and extra.get("env_steps"):
+            raise ValueError(
+                "--normalize_obs resume from a checkpoint without obs_norm "
+                "statistics: the restored policy/replay are in raw space — "
+                "resume without the flag, or restart training")
+    elif extra.get("obs_norm"):
+        raise ValueError(
+            "checkpoint was trained with --normalize_obs (its policy and "
+            "replay rows live in normalized space); resume with the flag")
     weights = WeightStore()
     weights.publish(
         state.actor_params if mesh is None else jax.device_get(state.actor_params),
@@ -299,6 +326,7 @@ def train(cfg: ExperimentConfig) -> dict:
                 f"actor-{w}", config, actor_cfg,
                 make_env_fn(cfg, seed=cfg.seed + w)(), service, weights,
                 her_ratio=cfg.her_ratio, rng_seed=cfg.seed + w, seed=cfg.seed + w,
+                obs_norm=obs_norm,
             )
         else:
             pool = EnvPool(
@@ -307,14 +335,15 @@ def train(cfg: ExperimentConfig) -> dict:
                 seed=cfg.seed + w,
             )
             actor = ActorWorker(f"actor-{w}", config, actor_cfg, pool, service,
-                                weights, seed=cfg.seed + w, obs_dtype=obs_dtype)
+                                weights, seed=cfg.seed + w, obs_dtype=obs_dtype,
+                                obs_norm=obs_norm)
         actors.append(actor)
     # Process 0 owns eval (multi-host: other hosts' rollouts would only be
     # discarded — their metrics bus has no sinks).
     evaluator = (
         Evaluator(config, make_env_fn(cfg, seed=cfg.seed + 777), weights,
                   max_steps=cfg.max_steps, goal_conditioned=cfg.her,
-                  device=cfg.actor_device)
+                  device=cfg.actor_device, obs_norm=obs_norm)
         if is_main else None
     )
     # Concurrent eval (main.py:395-397: the reference's evaluator is a
@@ -697,6 +726,8 @@ def train(cfg: ExperimentConfig) -> dict:
             if ckpt is not None and (cycle + 1) % cfg.checkpoint_every == 0:
                 n_saves += 1
                 extra_payload = {"env_steps": service.env_steps}
+                if obs_norm is not None:
+                    extra_payload["obs_norm"] = obs_norm.state_dict()
                 if (cfg.checkpoint_replay
                         and n_saves % max(1, cfg.checkpoint_replay_every) == 0):
                     # coarser cadence than the state checkpoint: the ring

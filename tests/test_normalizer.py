@@ -1,0 +1,71 @@
+"""Running observation normalizer (envs/normalizer.py) + actor wiring."""
+
+import numpy as np
+
+from d4pg_tpu.distributed import ReplayService, WeightStore
+from d4pg_tpu.distributed.actor import ActorConfig, GoalActorWorker
+from d4pg_tpu.envs import FakeGoalEnv
+from d4pg_tpu.envs.normalizer import RunningMeanStd
+from d4pg_tpu.learner import D4PGConfig
+from d4pg_tpu.replay import ReplayBuffer
+
+
+def test_running_mean_std_matches_numpy_oracle(rng):
+    norm = RunningMeanStd(5, eps=1e-8)
+    chunks = [rng.normal(3.0, 2.0, (n, 5)) * (1 + np.arange(5))
+              for n in (1, 7, 64, 128)]
+    for c in chunks:
+        norm.update(c)
+    all_rows = np.concatenate(chunks)
+    mean, std = norm.stats()
+    np.testing.assert_allclose(mean, all_rows.mean(0), rtol=1e-10)
+    np.testing.assert_allclose(std, all_rows.std(0), rtol=1e-6)
+    z = norm.normalize(all_rows)
+    np.testing.assert_allclose(z.mean(0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(z.std(0), 1.0, atol=1e-3)
+
+
+def test_normalize_clips_and_floors_std():
+    norm = RunningMeanStd(2, clip=5.0, eps=1e-2)
+    norm.update(np.ones((100, 2)))  # zero-variance dims
+    z = norm.normalize(np.array([[1.0, 1e9]]))
+    assert z[0, 0] == 0.0
+    assert z[0, 1] == 5.0  # clipped, not inf (std floored at eps)
+
+
+def test_state_dict_roundtrip(rng):
+    a = RunningMeanStd(3)
+    a.update(rng.normal(0, 1, (50, 3)))
+    b = RunningMeanStd(3)
+    b.load_state_dict(a.state_dict())
+    x = rng.normal(0, 1, (10, 3))
+    np.testing.assert_array_equal(a.normalize(x), b.normalize(x))
+    # continued updates agree too (count/m2 restored, not just mean/std)
+    more = rng.normal(2, 3, (30, 3))
+    a.update(more)
+    b.update(more)
+    np.testing.assert_allclose(a.stats()[1], b.stats()[1], rtol=1e-12)
+
+
+def test_goal_actor_stores_normalized_rows():
+    obs_dim = 2 + 2
+    config = D4PGConfig(obs_dim=obs_dim, act_dim=2, v_min=-50, v_max=0,
+                        n_atoms=11, hidden=(16, 16))
+    buf = ReplayBuffer(10_000, obs_dim, 2)
+    svc = ReplayService(buf)
+    ws = WeightStore()
+    norm = RunningMeanStd(obs_dim)
+    actor = GoalActorWorker("g0", config, ActorConfig(gamma=0.98),
+                            FakeGoalEnv(horizon=30, seed=0), svc, ws,
+                            her_ratio=1.0, rng_seed=2, obs_norm=norm)
+    for _ in range(4):
+        actor.run_episode(max_steps=30)
+    svc.flush()
+    n = len(svc)
+    assert n > 0
+    rows = buf.sample(min(n, 64))
+    # stored rows are standardized: bounded by the clip and roughly centered
+    assert np.abs(rows.obs).max() <= norm.clip + 1e-6
+    assert np.abs(rows.obs.mean()) < 1.5
+    # the estimator actually accumulated
+    assert norm.state_dict()["count"] > 0
