@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from d4pg_tpu.envs.her import her_relabel
+from d4pg_tpu.envs.normalizer import FrozenNormalizer, RunningMeanStd
 from d4pg_tpu.envs.vector import EnvPool
 from d4pg_tpu.envs.wrappers import flatten_goal_obs, rescale_action
 from d4pg_tpu.core.noise import ou
@@ -127,9 +128,12 @@ class _BaseActor:
         self.cfg = actor_cfg
         self.service = service
         self.weights = weights
-        # Shared RunningMeanStd (envs/normalizer.py) or None. Actors UPDATE
-        # it with fresh rows and store already-normalized observations, so
-        # the learner's jit'd update never sees raw scales.
+        # READ-ONLY normalizer view for the policy input (the networks are
+        # trained on standardized rows — the ReplayService's drain thread
+        # owns the statistics and normalizes at insert). In-process actors
+        # share the service's RunningMeanStd; remote/spawned actors receive
+        # a FrozenNormalizer refreshed from the weight channel (below).
+        # Transitions are ALWAYS streamed raw.
         self.obs_norm = obs_norm
         self._act_device = resolve_act_device(actor_cfg.device)
         with self._device_scope():
@@ -154,6 +158,15 @@ class _BaseActor:
         if got is not None:
             self._version, params = got
             self._params = put_params_on(self._act_device, params)
+            # Remote/spawned actors: the weight payload piggybacks the
+            # learner's normalization statistics (WeightClient.norm_stats).
+            # An in-process RunningMeanStd handle stays authoritative.
+            ns = getattr(self.weights, "norm_stats", None)
+            if ns is not None and not isinstance(self.obs_norm, RunningMeanStd):
+                if self.obs_norm is None:
+                    self.obs_norm = FrozenNormalizer(*ns)
+                else:
+                    self.obs_norm.set(*ns)
             return True
         return False
 
@@ -259,7 +272,6 @@ class ActorWorker(_BaseActor):
             if tick % self.cfg.weight_poll_every == 0:
                 self._maybe_pull_weights()
             if self.obs_norm is not None:
-                self.obs_norm.update(obs)
                 actions = self._explore_actions(self.obs_norm.normalize(obs))
             else:
                 actions = self._explore_actions(obs)
@@ -268,13 +280,6 @@ class ActorWorker(_BaseActor):
                 obs, actions, out.reward * self.cfg.reward_scale,
                 out.final_obs, out.terminated, out.truncated,
             )
-            if self.obs_norm is not None and folded.obs.shape[0]:
-                # the n-step window holds RAW obs; rows leave for replay in
-                # normalized form (current statistics)
-                folded = folded._replace(
-                    obs=self.obs_norm.normalize(folded.obs),
-                    next_obs=self.obs_norm.normalize(folded.next_obs),
-                )
             self.service.add(folded, actor_id=self.actor_id)
             done_any = out.terminated | out.truncated
             self._reset_noise(done_any)
@@ -376,22 +381,10 @@ class GoalActorWorker(_BaseActor):
         )
         relabeled = relabeled._replace(
             reward=relabeled.reward * self.cfg.reward_scale)
-        if self.obs_norm is not None:
-            # statistics cover what the networks will train on — original
-            # AND relabeled rows (the HER paper normalizes goals too; the
-            # goal dims' stats here come from both desired and achieved
-            # goals) — then both batches are stored normalized. Relabeling
-            # above ran on RAW values: compute_reward needs true distances.
-            self.obs_norm.update(originals.obs)
-            self.obs_norm.update(relabeled.obs)
-            originals = originals._replace(
-                obs=self.obs_norm.normalize(originals.obs),
-                next_obs=self.obs_norm.normalize(originals.next_obs),
-            )
-            relabeled = relabeled._replace(
-                obs=self.obs_norm.normalize(relabeled.obs),
-                next_obs=self.obs_norm.normalize(relabeled.next_obs),
-            )
+        # both batches stream RAW: the ReplayService drain normalizes at
+        # insert (and folds them into the statistics — original AND
+        # relabeled rows are what the networks train on, so goal dims get
+        # stats from desired and achieved goals alike)
         self.service.add(originals, actor_id=self.actor_id)
         # relabels are synthetic rows, not fresh env interaction: keep them
         # out of the env_steps counter (it is logged and checkpointed)

@@ -27,8 +27,16 @@ class ReplayService:
         buffer: ReplayBuffer,
         ingest_capacity: int = 256,
         heartbeat_timeout: float = 30.0,
+        obs_norm=None,
     ):
         self.buffer = buffer
+        # Optional RunningMeanStd (envs/normalizer.py). The drain thread is
+        # the SINGLE writer: it folds every ingested row (local, spawned or
+        # remote actors alike — they all stream RAW observations) into the
+        # statistics and inserts the rows normalized, so the learner only
+        # ever samples standardized data. Actors receive read-only
+        # statistics for their policy input via the weight channel.
+        self.obs_norm = obs_norm
         self._queue: queue.Queue = queue.Queue(maxsize=ingest_capacity)
         self._env_steps = 0
         self._lock = threading.Lock()
@@ -185,6 +193,12 @@ class ReplayService:
             except queue.Empty:
                 continue
             try:
+                if self.obs_norm is not None:
+                    self.obs_norm.update(batch.obs)
+                    batch = batch._replace(
+                        obs=self.obs_norm.normalize(batch.obs),
+                        next_obs=self.obs_norm.normalize(batch.next_obs),
+                    )
                 with self._buffer_lock:
                     self.buffer.add(batch)
             finally:

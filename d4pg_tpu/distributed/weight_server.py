@@ -104,6 +104,13 @@ class WeightServer:
                         continue
                     buf = io.BytesIO()
                     flat = _flatten(params)
+                    norm = getattr(self._store, "norm_stats", None)
+                    if norm is not None:
+                        # piggyback acting statistics (obs normalization):
+                        # remote actors must standardize policy inputs with
+                        # the same stats the learner's replay rows use
+                        flat["__norm_mean__"] = np.asarray(norm[0])
+                        flat["__norm_std__"] = np.asarray(norm[1])
                     np.savez(
                         buf,
                         __version__=np.int64(version),
@@ -134,6 +141,7 @@ class WeightClient:
         self._sock.settimeout(None)
         self._lock = threading.Lock()
         self.step = 0
+        self.norm_stats: tuple | None = None  # (mean, std) when served
 
     def get_if_newer(self, have_version: int):
         with self._lock:
@@ -153,6 +161,8 @@ class WeightClient:
             flat = {k: z[k] for k in z.files if not k.startswith("__")}
             version = int(z["__version__"])
             self.step = int(z["__step__"])
+            if "__norm_mean__" in z.files:
+                self.norm_stats = (z["__norm_mean__"], z["__norm_std__"])
         return version, _unflatten(flat)
 
     def close(self) -> None:

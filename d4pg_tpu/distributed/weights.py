@@ -25,8 +25,10 @@ class WeightStore:
         self._version = 0
         self._params: Any = None
         self._step = 0
+        self._norm_stats: tuple | None = None
 
-    def publish(self, params: Any, step: int, to_host: bool = True) -> int:
+    def publish(self, params: Any, step: int, to_host: bool = True,
+                norm_stats: tuple | None = None) -> int:
         """Learner-side: publish new actor params. ``to_host=True`` pulls
         device arrays to host numpy (a BLOCKING D2H sync) so readers never
         hold device references. The fused learner path instead publishes
@@ -41,7 +43,19 @@ class WeightStore:
             self._version += 1
             self._params = host
             self._step = int(step)
+            if norm_stats is not None:
+                # (mean, std) snapshot of the replay-side obs normalizer;
+                # piggybacked to remote actors by the WeightServer
+                self._norm_stats = norm_stats
             return self._version
+
+    @property
+    def norm_stats(self) -> tuple | None:
+        """Latest published (mean, std) acting statistics, or None when
+        observation normalization is off. In-process readers holding the
+        live RunningMeanStd ignore this; the TCP weight plane ships it."""
+        with self._lock:
+            return self._norm_stats
 
     @property
     def version(self) -> int:

@@ -7,16 +7,21 @@ whose scales differ by orders of magnitude; DDPG-family learners plateau
 without per-dimension standardization (the HER paper normalizes both obs
 and goals).
 
-Design for THIS framework's data plane: one host-side running estimator
-shared by every in-process actor and the evaluator. Actors update it with
-the rows they stream and store ALREADY-NORMALIZED observations in replay,
-so the jit'd learner update, the fused device path and the sharded data
-plane are untouched — normalization is a data-plane concern, not a model
-concern. Old replay rows keep the statistics they were written with
-(bounded drift, standard for replay normalizers à la VecNormalize); the
-estimator state rides the checkpoint ``extra`` payload for exact resume.
+Design for THIS framework's data plane: the ``ReplayService`` drain
+thread is the SINGLE writer — every actor (in-process, spawned or
+remote) streams RAW rows; the drain folds them into the statistics and
+inserts them normalized, so the jit'd learner update, the fused device
+path and the sharded data plane are untouched — normalization is a
+data-plane concern, not a model concern. Actors and the evaluator hold
+read-only views for the policy input: in-process components share the
+live ``RunningMeanStd``; remote/spawned actors get a
+:class:`FrozenNormalizer` refreshed from (mean, std) piggybacked on the
+weight channel. Old replay rows keep the statistics they were written
+with (bounded drift, standard for replay normalizers à la VecNormalize);
+the estimator state rides the checkpoint ``extra`` payload for exact
+resume.
 
-Thread-safe: actor threads update concurrently with evaluator reads.
+Thread-safe: the drain thread updates concurrently with actor/eval reads.
 """
 
 from __future__ import annotations
@@ -86,3 +91,21 @@ class RunningMeanStd:
             self._m2 = np.asarray(d["m2"], np.float64).copy()
             self.clip = float(d.get("clip", self.clip))
             self.eps = float(d.get("eps", self.eps))
+
+
+class FrozenNormalizer:
+    """Read-only (mean, std) view for actors that receive statistics over
+    the weight channel instead of sharing the learner's estimator —
+    refreshed via :meth:`set` on each weight pull."""
+
+    def __init__(self, mean: np.ndarray, std: np.ndarray, clip: float = 5.0):
+        self.clip = float(clip)
+        self.set(mean, std)
+
+    def set(self, mean: np.ndarray, std: np.ndarray) -> None:
+        self._mean = np.asarray(mean, np.float64)
+        self._std = np.maximum(np.asarray(std, np.float64), 1e-8)
+
+    def normalize(self, x: np.ndarray) -> np.ndarray:
+        out = (np.asarray(x, np.float64) - self._mean) / self._std
+        return np.clip(out, -self.clip, self.clip).astype(np.float32)

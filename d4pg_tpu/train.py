@@ -246,7 +246,24 @@ def train(cfg: ExperimentConfig) -> dict:
     if cfg.debug:
         print(f"replay storage: {storage} (fused={fused})", flush=True)
     beta = LinearSchedule(cfg.per_beta_steps, 1.0, cfg.per_beta0)
-    service = ReplayService(buffer)
+    # Observation normalization lives with the replay service (single
+    # writer: its drain thread folds every ingested row into the stats and
+    # inserts normalized); actors/eval hold read-only views, remote actors
+    # get (mean, std) over the weight channel.
+    obs_norm = None
+    if cfg.normalize_obs:
+        if config.pixels:
+            raise ValueError("--normalize_obs is for vector observations; "
+                             "the pixel encoder already normalizes by /255")
+        if multi_host:
+            # per-host stats would normalize each host's replay rows
+            # differently under globally-shared params
+            raise ValueError("--normalize_obs is not supported with the "
+                             "multi-host runtime yet")
+        from d4pg_tpu.envs.normalizer import RunningMeanStd
+
+        obs_norm = RunningMeanStd(config.obs_dim)
+    service = ReplayService(buffer, obs_norm=obs_norm)
 
     # --- io (process 0 owns all of it in multi-host mode) ----------------
     bus = MetricsBus(echo=is_main)
@@ -280,20 +297,7 @@ def train(cfg: ExperimentConfig) -> dict:
               f"{len(service)} replay rows)")
 
     # --- actors + evaluator ----------------------------------------------
-    obs_norm = None
-    if cfg.normalize_obs:
-        if config.pixels:
-            raise ValueError("--normalize_obs is for vector observations; "
-                             "the pixel encoder already normalizes by /255")
-        if cfg.actor_procs or cfg.serve:
-            # spawned/remote actors have no handle on this process's
-            # statistics yet; mixing their raw rows with in-process
-            # normalized rows would silently corrupt training
-            raise ValueError("--normalize_obs currently requires in-process "
-                             "actors (no --actor_procs / --serve)")
-        from d4pg_tpu.envs.normalizer import RunningMeanStd
-
-        obs_norm = RunningMeanStd(config.obs_dim)
+    if obs_norm is not None:
         if extra.get("obs_norm"):
             # resume with the statistics the stored replay rows (and the
             # restored policy) were normalized with
@@ -308,9 +312,14 @@ def train(cfg: ExperimentConfig) -> dict:
             "checkpoint was trained with --normalize_obs (its policy and "
             "replay rows live in normalized space); resume with the flag")
     weights = WeightStore()
+
+    def _norm_snapshot():
+        return obs_norm.stats() if obs_norm is not None else None
+
     weights.publish(
         state.actor_params if mesh is None else jax.device_get(state.actor_params),
         step=int(jax.device_get(state.step)),
+        norm_stats=_norm_snapshot(),
     )
     actor_cfg = ActorConfig(
         epsilon_0=cfg.epsilon_0, min_epsilon=cfg.min_epsilon,
@@ -425,7 +434,7 @@ def train(cfg: ExperimentConfig) -> dict:
 
     def publish():
         p = state.actor_params if mesh is None else jax.device_get(state.actor_params)
-        weights.publish(p, step=lstep)
+        weights.publish(p, step=lstep, norm_stats=_norm_snapshot())
 
     # Fused K-updates-per-dispatch path. With a mesh this composes with
     # data parallelism: batches are stacked [K, B, ...] with K replicated
@@ -493,7 +502,8 @@ def train(cfg: ExperimentConfig) -> dict:
                 # next chunk's donation would otherwise invalidate the
                 # buffers readers hold) instead of a blocking D2H pull
                 weights.publish(copy_params(state.actor_params),
-                                step=lstep, to_host=False)
+                                step=lstep, to_host=False,
+                                norm_stats=_norm_snapshot())
         if metrics is None:
             return None
         return {name: metrics[name][-1]
@@ -558,7 +568,8 @@ def train(cfg: ExperimentConfig) -> dict:
         if cfg.async_actors:
             p = (chunk_state.actor_params if mesh is None
                  else jax.device_get(chunk_state.actor_params))
-            weights.publish(p, step=lstep)  # bounded staleness: lag <= K
+            weights.publish(p, step=lstep,  # bounded staleness: lag <= K
+                            norm_stats=_norm_snapshot())
 
     def _stage_single(batch):
         """Place a host-local [B, ...] batch for the update: multi-host

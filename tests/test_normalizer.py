@@ -47,14 +47,16 @@ def test_state_dict_roundtrip(rng):
     np.testing.assert_allclose(a.stats()[1], b.stats()[1], rtol=1e-12)
 
 
-def test_goal_actor_stores_normalized_rows():
+def test_service_drain_normalizes_rows():
+    """The ReplayService is the single writer: actors stream RAW rows, the
+    drain thread folds them into the statistics and inserts normalized."""
     obs_dim = 2 + 2
     config = D4PGConfig(obs_dim=obs_dim, act_dim=2, v_min=-50, v_max=0,
                         n_atoms=11, hidden=(16, 16))
     buf = ReplayBuffer(10_000, obs_dim, 2)
-    svc = ReplayService(buf)
-    ws = WeightStore()
     norm = RunningMeanStd(obs_dim)
+    svc = ReplayService(buf, obs_norm=norm)
+    ws = WeightStore()
     actor = GoalActorWorker("g0", config, ActorConfig(gamma=0.98),
                             FakeGoalEnv(horizon=30, seed=0), svc, ws,
                             her_ratio=1.0, rng_seed=2, obs_norm=norm)
@@ -67,5 +69,42 @@ def test_goal_actor_stores_normalized_rows():
     # stored rows are standardized: bounded by the clip and roughly centered
     assert np.abs(rows.obs).max() <= norm.clip + 1e-6
     assert np.abs(rows.obs.mean()) < 1.5
-    # the estimator actually accumulated
+    # the estimator accumulated original AND relabeled rows
     assert norm.state_dict()["count"] > 0
+    svc.close()
+
+
+def test_norm_stats_ride_the_weight_channel():
+    """Remote actors get (mean, std) with the weights: WeightServer embeds
+    the store's published stats, WeightClient exposes them, and the actor
+    builds a FrozenNormalizer from the pull."""
+    import jax as _jax
+
+    from d4pg_tpu.distributed.weight_server import WeightClient, WeightServer
+    from d4pg_tpu.envs.normalizer import FrozenNormalizer
+    from d4pg_tpu.learner import init_state
+
+    config = D4PGConfig(obs_dim=4, act_dim=2, v_min=-5, v_max=0, n_atoms=11,
+                        hidden=(16, 16))
+    store = WeightStore()
+    norm = RunningMeanStd(4)
+    norm.update(np.arange(40, dtype=np.float64).reshape(10, 4))
+    store.publish(init_state(config, _jax.random.key(0)).actor_params,
+                  step=7, norm_stats=norm.stats())
+    server = WeightServer(store)
+    client = WeightClient("127.0.0.1", server.port)
+    try:
+        got = client.get_if_newer(0)
+        assert got is not None
+        assert client.norm_stats is not None
+        mean, std = norm.stats()
+        np.testing.assert_allclose(client.norm_stats[0], mean)
+        np.testing.assert_allclose(client.norm_stats[1], std)
+        # the actor-side view normalizes identically to the live estimator
+        frozen = FrozenNormalizer(*client.norm_stats)
+        x = np.random.default_rng(0).normal(0, 10, (6, 4))
+        np.testing.assert_allclose(frozen.normalize(x), norm.normalize(x),
+                                   rtol=1e-6)
+    finally:
+        client.close()
+        server.close()
