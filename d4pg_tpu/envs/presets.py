@@ -44,6 +44,16 @@ PRESETS: dict[str, EnvPreset] = {
     "cheetah-run-pixels": EnvPreset(
         "cheetah-run-pixels", v_min=0.0, v_max=1000.0, pixels=True
     ),
+    # dm_control state-based tasks. Suite rewards are in [0, 1] per PHYSICS
+    # step and the adapter sums them over action_repeat=4, so the per-tick
+    # reward reaches 4 and the discounted return 4/(1-0.99) = 400.
+    "dmc:cheetah-run": EnvPreset("dmc:cheetah-run", v_min=0.0, v_max=400.0,
+                                 max_steps=250),
+    "dmc:walker-walk": EnvPreset("dmc:walker-walk", v_min=0.0, v_max=400.0,
+                                 max_steps=250),
+    "dmc:cartpole-swingup": EnvPreset(
+        "dmc:cartpole-swingup", v_min=0.0, v_max=400.0, max_steps=250
+    ),
     "AdroitHandDoor-v1": EnvPreset(
         "AdroitHandDoor-v1", v_min=-100.0, v_max=300.0, goal_conditioned=False
     ),

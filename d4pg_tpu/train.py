@@ -273,8 +273,12 @@ def train(cfg: ExperimentConfig) -> dict:
             bus.add_sink(TensorBoardSink(run_dir))
         except Exception as e:  # tensorboard optional at runtime
             print(f"tensorboard disabled: {e}")
-        bus.add_sink(CsvLogger(os.path.join(run_dir, "returns.csv"),
-                               ["avg_test_reward", "ewma_test_reward"]))
+        # first two data columns keep the reference's offline-plot shape
+        # (plots/plots.py:29-37 reads step,avg,curr); success_rate rides as
+        # a third column for the sparse-reward/HER evidence plots
+        bus.add_sink(CsvLogger(
+            os.path.join(run_dir, "returns.csv"),
+            ["avg_test_reward", "ewma_test_reward", "success_rate"]))
         ckpt = CheckpointManager(
             os.path.join(run_dir, "ckpt"),
             active_processes={0} if multi_host else None)
@@ -435,6 +439,13 @@ def train(cfg: ExperimentConfig) -> dict:
     def publish():
         p = state.actor_params if mesh is None else jax.device_get(state.actor_params)
         weights.publish(p, step=lstep, norm_stats=_norm_snapshot())
+
+    if obs_norm is not None:
+        # warmup just populated the statistics; remote/spawned actors built
+        # their FrozenNormalizer from the count-0 pre-warmup publish and
+        # won't see a newer weight version until training publishes —
+        # re-publish now so the fleet acts on real stats from step one
+        publish()
 
     # Fused K-updates-per-dispatch path. With a mesh this composes with
     # data parallelism: batches are stacked [K, B, ...] with K replicated
