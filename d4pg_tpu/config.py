@@ -62,6 +62,8 @@ class ExperimentConfig:
     # algorithm
     gamma: float = 0.99  # --gamma
     tau: float = 0.001  # --tau
+    # HER-recipe action-L2 penalty on the actor loss (0 = reference objective)
+    action_l2: float = 0.0
     lr_actor: float = 1e-4
     lr_critic: float = 1e-3
     adam_b1: float = 0.9
@@ -167,6 +169,7 @@ class ExperimentConfig:
         ``strict_reference`` switches to the reference's own preset values
         and training hyperparameters wholesale."""
         preset = get_preset(self.env, strict=self.strict_reference)
+        d = ExperimentConfig.__dataclass_fields__
         updates: dict = {}
         if self.v_min is None:
             updates["v_min"] = preset.v_min
@@ -174,6 +177,13 @@ class ExperimentConfig:
             updates["v_max"] = preset.v_max
         if self.reward_scale == 1.0 and preset.reward_scale != 1.0:
             updates["reward_scale"] = preset.reward_scale
+        # horizon / n-step from the preset when the user left the defaults
+        # (an explicitly-passed default value is indistinguishable — presets
+        # win there; pass a non-default to override a preset)
+        if self.max_steps == d["max_steps"].default != preset.max_steps:
+            updates["max_steps"] = preset.max_steps
+        if self.n_steps == d["n_steps"].default != preset.n_step:
+            updates["n_steps"] = preset.n_step
         if self.strict_reference:
             updates.update(
                 reward_scale=1.0,
@@ -207,6 +217,7 @@ class ExperimentConfig:
             compute_dtype=self.compute_dtype,
             tau=self.tau,
             gamma=self.gamma,
+            action_l2=self.action_l2,
         )
 
 
@@ -245,6 +256,7 @@ def build_parser() -> argparse.ArgumentParser:
                    default=d.updates_per_dispatch)
     p.add_argument("--gamma", type=float, default=d.gamma)
     p.add_argument("--tau", type=float, default=d.tau)
+    p.add_argument("--action_l2", type=float, default=d.action_l2)
     p.add_argument("--lr_actor", type=float, default=d.lr_actor)
     p.add_argument("--lr_critic", type=float, default=d.lr_critic)
     p.add_argument("--adam_b1", type=float, default=d.adam_b1)

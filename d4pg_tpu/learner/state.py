@@ -58,6 +58,9 @@ class D4PGConfig:
     adam_b2: float = 0.999
     tau: float = 0.001
     gamma: float = 0.99
+    # HER-recipe action-L2 penalty coefficient on the actor loss (0 = the
+    # reference's plain expected-Q objective)
+    action_l2: float = 0.0
     pixels: bool = False  # conv-encoder path (BASELINE.md config #4)
     obs_shape: tuple = ()  # [H, W, C] when pixels=True
     mog_samples: int = 32

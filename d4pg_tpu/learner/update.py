@@ -83,15 +83,22 @@ def _actor_loss_fn(
     critic_params: Any,
     batch: TransitionBatch,
 ) -> Array:
-    """Negative expected Q through the (fixed) critic (``ddpg.py:236-238``)."""
+    """Negative expected Q through the (fixed) critic (``ddpg.py:236-238``),
+    plus the HER recipe's optional action-L2 penalty (``action_l2 *
+    mean(a^2)`` over all elements — the OpenAI-baselines normalization, so
+    published Fetch coefficients transfer regardless of act_dim)
+    discouraging saturated tanh actions on sparse-reward manipulation
+    tasks. With ``action_l2 > 0`` the reported ``actor_loss`` / ``q_mean``
+    metrics include the penalty term."""
     actor = config.build_actor()
     critic = config.build_critic()
     action = actor.apply(actor_params, batch.obs)
+    penalty = config.action_l2 * jnp.mean(jnp.square(action))
     if config.critic_family == "mog":
         params = critic.apply(critic_params, batch.obs, action)
-        return -jnp.mean(mog_ops.mog_mean(params))
+        return -jnp.mean(mog_ops.mog_mean(params)) + penalty
     probs = critic.apply(critic_params, batch.obs, action)
-    return -jnp.mean(expected_q(config.support, probs))
+    return -jnp.mean(expected_q(config.support, probs)) + penalty
 
 
 def update_step(

@@ -204,3 +204,26 @@ def test_bfloat16_compute_dtype(rng):
 def test_bad_compute_dtype_rejected():
     with pytest.raises(ValueError):
         _config(compute_dtype="float16")
+
+
+def test_action_l2_penalty(rng):
+    """action_l2 adds exactly l2 * mean(|pi(s)|^2) to the actor loss (the
+    HER recipe's penalty; 0 = reference objective) and flows into training."""
+    from d4pg_tpu.learner.update import _actor_loss_fn
+
+    base_cfg = _config()
+    pen_cfg = _config(action_l2=0.5)
+    state = init_state(base_cfg, jax.random.key(0))
+    batch = _batch(rng)
+    actor = base_cfg.build_actor()
+    a = actor.apply(state.actor_params, batch.obs)
+    expected_pen = 0.5 * float(jnp.mean(jnp.square(a)))  # baselines norm
+    base = float(_actor_loss_fn(base_cfg, state.actor_params,
+                                state.critic_params, batch))
+    pen = float(_actor_loss_fn(pen_cfg, state.actor_params,
+                               state.critic_params, batch))
+    np.testing.assert_allclose(pen - base, expected_pen, rtol=1e-5)
+    # and the jit'd update accepts the config (static field, new cache key)
+    update = make_update(pen_cfg, donate=False)
+    new_state, metrics = update(state, batch, jnp.ones((B,), jnp.float32))
+    assert np.isfinite(float(metrics["actor_loss"]))
