@@ -13,7 +13,11 @@ from __future__ import annotations
 import argparse
 
 from d4pg_tpu.config import ExperimentConfig
-from d4pg_tpu.distributed.actor import ActorConfig, ActorWorker
+from d4pg_tpu.distributed.actor import (
+    ActorConfig,
+    ActorWorker,
+    GoalActorWorker,
+)
 from d4pg_tpu.distributed.transport import TransitionSender
 from d4pg_tpu.distributed.weight_server import WeightClient
 from d4pg_tpu.envs import EnvPool
@@ -30,11 +34,11 @@ class RemoteReplayClient:
     def add(self, batch: TransitionBatch, actor_id: str = "remote",
             block: bool = True, timeout: float | None = None,
             count_env_steps: bool = True) -> bool:
-        # TCP provides ordering + backpressure. count_env_steps does not
-        # cross the wire: the learner counts every remote row as an env
-        # step (remote HER actors would need a frame flag — not wired).
-        del actor_id, block, timeout, count_env_steps
-        self._sender.send(batch)
+        # TCP provides ordering + backpressure. count_env_steps crosses the
+        # wire as a frame flag so remote HER relabels don't inflate the
+        # learner's env-step counter.
+        del actor_id, block, timeout
+        self._sender.send(batch, count_env_steps=count_env_steps)
         return True
 
 
@@ -53,34 +57,52 @@ def run_actor(
     sender = TransitionSender(learner_host, transitions_port,
                               actor_id=actor_id, secret=secret)
     weights = WeightClient(learner_host, weights_port, secret=secret)
-    pool = EnvPool(
-        [make_env_fn(cfg, seed=cfg.seed + i) for i in range(cfg.num_envs)],
-        seed=cfg.seed,
+    actor_cfg = ActorConfig(
+        epsilon_0=cfg.epsilon_0, min_epsilon=cfg.min_epsilon,
+        epsilon_horizon=cfg.epsilon_horizon, n_step=cfg.n_steps,
+        gamma=cfg.gamma, reward_scale=cfg.reward_scale, noise=cfg.noise,
+        random_eps=cfg.random_eps, ou_theta=cfg.ou_theta,
+        ou_sigma=cfg.ou_sigma, ou_mu=cfg.ou_mu, device=cfg.actor_device,
     )
-    actor = ActorWorker(
-        actor_id, config,
-        ActorConfig(
-            epsilon_0=cfg.epsilon_0, min_epsilon=cfg.min_epsilon,
-            epsilon_horizon=cfg.epsilon_horizon, n_step=cfg.n_steps,
-            gamma=cfg.gamma, reward_scale=cfg.reward_scale, noise=cfg.noise,
-            random_eps=cfg.random_eps, ou_theta=cfg.ou_theta,
-            ou_sigma=cfg.ou_sigma, ou_mu=cfg.ou_mu, device=cfg.actor_device,
-        ),
-        pool, RemoteReplayClient(sender), weights, seed=cfg.seed,
-        obs_dtype=obs_dtype,
-    )
+    pool = None
+    goal_env = None
+    if cfg.her:
+        # remote goal actor: whole episodes on one env, originals + HER
+        # relabels streamed with the count_env_steps frame flag so the
+        # learner's env-step counter stays honest
+        goal_env = make_env_fn(cfg, seed=cfg.seed)()
+        actor = GoalActorWorker(
+            actor_id, config, actor_cfg, goal_env,
+            RemoteReplayClient(sender), weights, her_ratio=cfg.her_ratio,
+            rng_seed=cfg.seed, seed=cfg.seed,
+        )
+    else:
+        pool = EnvPool(
+            [make_env_fn(cfg, seed=cfg.seed + i) for i in range(cfg.num_envs)],
+            seed=cfg.seed,
+        )
+        actor = ActorWorker(
+            actor_id, config, actor_cfg, pool, RemoteReplayClient(sender),
+            weights, seed=cfg.seed, obs_dtype=obs_dtype,
+        )
     try:
-        if max_ticks is None:
-            while True:
-                actor.run(1000)
-        else:
-            actor.run(max_ticks)
+        done = 0
+        while max_ticks is None or done < max_ticks:
+            if cfg.her:
+                done += actor.run_episode(cfg.max_steps)
+            else:
+                chunk = 1000 if max_ticks is None else min(1000, max_ticks - done)
+                actor.run(chunk)
+                done += chunk
     except (KeyboardInterrupt, ConnectionError, BrokenPipeError, OSError) as e:
         print(f"actor {actor_id} stopping: {type(e).__name__}: {e}")
     finally:
         sender.close()
         weights.close()
-        pool.close()
+        if pool is not None:
+            pool.close()
+        if goal_env is not None and hasattr(goal_env, "close"):
+            goal_env.close()
     return actor.env_steps
 
 
@@ -118,10 +140,15 @@ def main(argv=None):
     p.add_argument("--actor_id", default="remote-0")
     p.add_argument("--env", default="Pendulum-v1")
     p.add_argument("--num_envs", type=int, default=4)
-    p.add_argument("--n_steps", type=int, default=3)
+    p.add_argument("--n_steps", type=int, default=None,
+                   help="n-step horizon (default: from the env preset)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--noise", choices=("gaussian", "ou"), default="gaussian")
     p.add_argument("--random_eps", type=float, default=0.0)
+    p.add_argument("--her", type=int, choices=(0, 1), default=0)
+    p.add_argument("--her_ratio", type=float, default=0.8)
+    p.add_argument("--max_steps", type=int, default=None,
+                   help="episode horizon (default: from the env preset)")
     p.add_argument("--max_ticks", type=int, default=None)
     p.add_argument("--secret", default="",
                    help="shared secret matching the learner's --serve_secret")
@@ -134,10 +161,11 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    cfg = ExperimentConfig(env=ns.env, num_envs=ns.num_envs, n_steps=ns.n_steps,
-                           seed=ns.seed, noise=ns.noise,
-                           random_eps=ns.random_eps,
-                           actor_device=ns.actor_device)
+    cfg = ExperimentConfig(
+        env=ns.env, num_envs=ns.num_envs, n_steps=ns.n_steps,
+        max_steps=ns.max_steps, seed=ns.seed, noise=ns.noise,
+        random_eps=ns.random_eps, her=bool(ns.her), her_ratio=ns.her_ratio,
+        actor_device=ns.actor_device)
     steps = run_actor(cfg, ns.learner_host, ns.transitions_port,
                       ns.weights_port, ns.actor_id, ns.max_ticks,
                       secret=ns.secret or None)

@@ -35,7 +35,9 @@ def measure(n_procs: int, seconds: float, env: str = "point",
         ReplayBuffer(1_000_000, obs_dim, act_dim, obs_dtype=obs_dtype))
     weights = WeightStore()
     receiver = TransitionReceiver(
-        lambda b, aid: service.add(b, actor_id=aid), host="127.0.0.1")
+        lambda b, aid, count: service.add(b, actor_id=aid,
+                                          count_env_steps=count),
+        host="127.0.0.1")
     weight_server = WeightServer(weights, host="127.0.0.1")
 
     ctx = mp.get_context("spawn")

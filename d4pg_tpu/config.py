@@ -16,7 +16,7 @@ import dataclasses
 
 import numpy as np
 
-from d4pg_tpu.envs.presets import get_preset
+from d4pg_tpu.envs.presets import get_preset, has_preset
 from d4pg_tpu.learner.state import D4PGConfig
 
 
@@ -24,7 +24,10 @@ from d4pg_tpu.learner.state import D4PGConfig
 class ExperimentConfig:
     # env
     env: str = "Pendulum-v1"  # --env
-    max_steps: int = 200  # --max_steps (episode horizon)
+    # episode horizon; None = from the env preset when one is curated, else
+    # 200 (the reference's --max_steps default). An explicit value always
+    # wins over the preset.
+    max_steps: int | None = None  # --max_steps
     num_envs: int = 4  # vectorized pool width (reference: 1)
     her: bool = False  # --her
     her_ratio: float = 0.8  # main.py:165
@@ -37,7 +40,8 @@ class ExperimentConfig:
     per_alpha: float = 0.6  # ddpg.py:81
     per_beta0: float = 0.4  # ddpg.py:84
     per_beta_steps: int = 100_000  # ddpg.py:85
-    n_steps: int = 3  # --n_steps
+    # n-step return horizon; None = from a curated env preset, else 3
+    n_steps: int | None = None  # --n_steps
     # 'device': transition ring in accelerator HBM (host keeps PER trees,
     # picks indices; per-dispatch H2D is O(indices) not O(batch bytes));
     # 'auto' selects device on an accelerator single-device learner.
@@ -155,12 +159,15 @@ class ExperimentConfig:
     strict_reference: bool = False
 
     def run_name(self) -> str:
-        """Config-encoded run dir (parity: ``main.py:59-64``)."""
+        """Config-encoded run dir (parity: ``main.py:59-64``). Resolves
+        first so a preset-defaulted n_steps (None until resolve) encodes
+        identically on resolved and unresolved configs."""
+        cfg = self.resolve()
         return (
-            f"exp_{self.env}_"
-            f"{'_PER' if self.prioritized_replay else ''}"
-            f"{'_HER' if self.her else ''}"
-            f"_{self.n_steps}N_{self.n_workers}Workers"
+            f"exp_{cfg.env}_"
+            f"{'_PER' if cfg.prioritized_replay else ''}"
+            f"{'_HER' if cfg.her else ''}"
+            f"_{cfg.n_steps}N_{cfg.n_workers}Workers"
         )
 
     def resolve(self) -> "ExperimentConfig":
@@ -169,7 +176,7 @@ class ExperimentConfig:
         ``strict_reference`` switches to the reference's own preset values
         and training hyperparameters wholesale."""
         preset = get_preset(self.env, strict=self.strict_reference)
-        d = ExperimentConfig.__dataclass_fields__
+        curated = has_preset(self.env, strict=self.strict_reference)
         updates: dict = {}
         if self.v_min is None:
             updates["v_min"] = preset.v_min
@@ -177,13 +184,13 @@ class ExperimentConfig:
             updates["v_max"] = preset.v_max
         if self.reward_scale == 1.0 and preset.reward_scale != 1.0:
             updates["reward_scale"] = preset.reward_scale
-        # horizon / n-step from the preset when the user left the defaults
-        # (an explicitly-passed default value is indistinguishable — presets
-        # win there; pass a non-default to override a preset)
-        if self.max_steps == d["max_steps"].default != preset.max_steps:
-            updates["max_steps"] = preset.max_steps
-        if self.n_steps == d["n_steps"].default != preset.n_step:
-            updates["n_steps"] = preset.n_step
+        # horizon / n-step: unset (None) -> curated preset value, else the
+        # reference defaults (200 / 3); explicit values always win, and the
+        # fallback preset's own field defaults never masquerade as curation
+        if self.max_steps is None:
+            updates["max_steps"] = preset.max_steps if curated else 200
+        if self.n_steps is None:
+            updates["n_steps"] = preset.n_step if curated else 3
         if self.strict_reference:
             updates.update(
                 reward_scale=1.0,

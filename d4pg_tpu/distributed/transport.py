@@ -84,7 +84,8 @@ def client_handshake(sock: socket.socket, secret: Optional[str],
         sock.settimeout(prev)
 
 
-def _encode(actor_id: str, batch: TransitionBatch) -> bytes:
+def _encode(actor_id: str, batch: TransitionBatch,
+            count_env_steps: bool = True) -> bytes:
     buf = io.BytesIO()
     np.savez(
         buf,
@@ -95,19 +96,23 @@ def _encode(actor_id: str, batch: TransitionBatch) -> bytes:
         next_obs=batch.next_obs,
         done=batch.done,
         discount=batch.discount,
+        # synthetic rows (HER relabels) must not inflate the learner's
+        # env-step counter (ADVICE r1: (1+her_ratio)x inflation otherwise)
+        count=np.uint8(count_env_steps),
     )
     payload = buf.getvalue()
     return _HEADER.pack(_MAGIC, len(payload)) + payload
 
 
-def _decode(payload: bytes) -> tuple[str, TransitionBatch]:
+def _decode(payload: bytes) -> tuple[str, TransitionBatch, bool]:
     with np.load(io.BytesIO(payload)) as z:
         actor_id = z["actor_id"].tobytes().decode()
         batch = TransitionBatch(
             obs=z["obs"], action=z["action"], reward=z["reward"],
             next_obs=z["next_obs"], done=z["done"], discount=z["discount"],
         )
-    return actor_id, batch
+        count = bool(z["count"]) if "count" in z.files else True
+    return actor_id, batch, count
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
@@ -132,8 +137,8 @@ class TransitionSender:
         self._sock.settimeout(None)
         self._lock = threading.Lock()
 
-    def send(self, batch: TransitionBatch) -> None:
-        data = _encode(self.actor_id, batch)
+    def send(self, batch: TransitionBatch, count_env_steps: bool = True) -> None:
+        data = _encode(self.actor_id, batch, count_env_steps)
         with self._lock:
             self._sock.sendall(data)
 
@@ -147,11 +152,12 @@ class TransitionSender:
 
 class TransitionReceiver:
     """Learner-side server: accepts actor connections, decodes frames, and
-    forwards batches into a callback (normally ``ReplayService.add``)."""
+    forwards batches into a callback (normally ``ReplayService.add``).
+    The callback receives ``(batch, actor_id, count_env_steps)``."""
 
     def __init__(
         self,
-        on_batch: Callable[[TransitionBatch, str], object],
+        on_batch: Callable[[TransitionBatch, str, bool], object],
         host: str = "127.0.0.1",
         port: int = 0,
         secret: Optional[str] = None,
@@ -198,8 +204,8 @@ class TransitionReceiver:
                     payload = _recv_exact(conn, length)
                     if payload is None:
                         return
-                    actor_id, batch = _decode(payload)
-                    self._on_batch(batch, actor_id)
+                    actor_id, batch, count = _decode(payload)
+                    self._on_batch(batch, actor_id, count)
         except OSError:
             return  # peer died mid-frame (actor killed); just drop it
 

@@ -86,6 +86,13 @@ PRESETS_STRICT: dict[str, EnvPreset] = {
 }
 
 
+def has_preset(env_id: str, strict: bool = False) -> bool:
+    """True when a CURATED preset exists for ``env_id`` (the permissive
+    fallback of :func:`get_preset` does not count — its field defaults are
+    placeholders, not per-env tuning)."""
+    return env_id in PRESETS or (strict and env_id in PRESETS_STRICT)
+
+
 def get_preset(env_id: str, strict: bool = False) -> EnvPreset:
     """Preset lookup with a permissive default (wide symmetric support).
     ``strict=True`` prefers the reference's own values where they exist."""

@@ -386,7 +386,8 @@ def train(cfg: ExperimentConfig) -> dict:
         from d4pg_tpu.distributed.weight_server import WeightServer
 
         receiver = TransitionReceiver(
-            lambda b, aid: service.add(b, actor_id=aid),
+            lambda b, aid, count: service.add(b, actor_id=aid,
+                                              count_env_steps=count),
             host=cfg.serve_host,
             port=cfg.serve_transitions_port,
             secret=cfg.serve_secret or None,

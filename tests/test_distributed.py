@@ -259,7 +259,8 @@ def test_evaluator_ewma_and_success():
 def test_socket_transport_roundtrip():
     """Frames survive the wire; receiver feeds the service callback."""
     svc = ReplayService(ReplayBuffer(1000, 4, 2))
-    recv = TransitionReceiver(lambda b, aid: svc.add(b, actor_id=aid),
+    recv = TransitionReceiver(lambda b, aid, count: svc.add(
+        b, actor_id=aid, count_env_steps=count),
                               host="127.0.0.1")
     sender = TransitionSender("127.0.0.1", recv.port, actor_id="remote-7")
     sent = _batch(16)
@@ -366,7 +367,8 @@ def test_transport_rejects_wrong_secret_and_oversized_frames():
     import time as _time
 
     svc = ReplayService(ReplayBuffer(1000, 4, 2))
-    recv = TransitionReceiver(lambda b, aid: svc.add(b, actor_id=aid),
+    recv = TransitionReceiver(lambda b, aid, count: svc.add(
+        b, actor_id=aid, count_env_steps=count),
                               host="127.0.0.1", secret="sesame",
                               max_payload=1 << 20)
     # right secret: frames land

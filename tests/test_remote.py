@@ -63,7 +63,8 @@ def test_remote_actor_streams_to_learner():
     service = ReplayService(ReplayBuffer(10_000, obs_dim, act_dim))
     store = WeightStore()
     store.publish(init_state(config, jax.random.key(0)).actor_params, step=0)
-    receiver = TransitionReceiver(lambda b, aid: service.add(b, actor_id=aid),
+    receiver = TransitionReceiver(lambda b, aid, count: service.add(
+        b, actor_id=aid, count_env_steps=count),
                                   host="127.0.0.1")
     server = WeightServer(store, host="127.0.0.1")
 
@@ -96,3 +97,36 @@ def test_async_actor_training(tmp_path):
     assert "grad_steps_per_sec" in metrics
     # async actors kept collecting beyond the warmup
     assert metrics["env_steps"] > 100
+
+
+def test_remote_goal_actor_her_over_the_wire():
+    """Remote HER: a goal actor on 'another host' streams originals AND
+    relabels; the count_env_steps frame flag keeps the learner's env-step
+    counter at the original rows only (no (1+her_ratio)x inflation)."""
+    from d4pg_tpu.actor_main import run_actor
+
+    cfg = ExperimentConfig(env="fake-goal", her=True, her_ratio=1.0,
+                           max_steps=20, n_steps=1, v_min=-50.0, v_max=0.0,
+                           hidden=(16, 16), n_atoms=11)
+    obs_dim, act_dim = 4, 2  # 2 obs + 2 goal
+    config = cfg.learner_config(obs_dim, act_dim)
+    service = ReplayService(ReplayBuffer(10_000, obs_dim, act_dim))
+    store = WeightStore()
+    store.publish(init_state(config, jax.random.key(0)).actor_params, step=0)
+    receiver = TransitionReceiver(lambda b, aid, count: service.add(
+        b, actor_id=aid, count_env_steps=count), host="127.0.0.1")
+    server = WeightServer(store, host="127.0.0.1")
+
+    steps = run_actor(cfg, "127.0.0.1", receiver.port, server.port,
+                      actor_id="remote-her", max_ticks=25)
+    deadline = time.monotonic() + 5.0
+    while len(service) < 2 * steps and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert steps > 0
+    # originals + her_ratio=1.0 relabels arrived...
+    assert len(service) == 2 * steps
+    # ...but only originals count as env interaction
+    assert service.env_steps == steps
+    receiver.close()
+    server.close()
+    service.close()
