@@ -17,20 +17,24 @@ import numpy as np
 from d4pg_tpu.analysis.ewma import ewma
 
 
-def load_returns_csv(path: str) -> tuple[np.ndarray, np.ndarray]:
-    """Read (step, avg_return[, ...]) rows; returns (steps, returns)."""
-    steps, rets = [], []
+def load_returns_csv(
+    path: str, column: int = 1
+) -> tuple[np.ndarray, np.ndarray]:
+    """Read (step, avg_return[, ewma[, success_rate]]) rows; returns
+    (steps, values) for the requested data ``column`` (1 = avg return,
+    3 = success rate for sparse-reward/HER runs)."""
+    steps, vals = [], []
     with open(path) as f:
         for row in csv.reader(f):
             if not row:
                 continue
             try:
-                step, ret = float(row[0]), float(row[1])
+                step, val = float(row[0]), float(row[column])
             except (ValueError, IndexError):
-                continue  # header or malformed row
+                continue  # header, malformed, or column absent in old runs
             steps.append(step)
-            rets.append(ret)
-    return np.asarray(steps), np.asarray(rets)
+            vals.append(val)
+    return np.asarray(steps), np.asarray(vals)
 
 
 def plot_runs(
@@ -38,6 +42,7 @@ def plot_runs(
     out_path: str,
     alpha: float = 0.95,
     title: str = "returns",
+    ylabel: str | None = None,
 ) -> str:
     """Overlay EWMA-smoothed return curves; writes a PNG, returns its path."""
     import matplotlib
@@ -52,7 +57,7 @@ def plot_runs(
         ax.plot(steps, ewma(rets, alpha), label=name)
         ax.plot(steps, rets, alpha=0.2)
     ax.set_xlabel("learner step")
-    ax.set_ylabel("avg test return (EWMA)")
+    ax.set_ylabel(ylabel or "avg test return (EWMA)")
     ax.set_title(title)
     ax.legend()
     fig.tight_layout()
@@ -63,20 +68,35 @@ def plot_runs(
 
 def main(argv=None) -> None:
     argv = sys.argv[1:] if argv is None else argv
+    success = "--success" in argv
+    argv = [a for a in argv if a != "--success"]
     if not argv:
-        print("usage: python -m d4pg_tpu.analysis.plots <run_dir> [...]")
+        print("usage: python -m d4pg_tpu.analysis.plots [--success] "
+              "<run_dir> [...]")
         raise SystemExit(2)
+    column = 3 if success else 1
     runs = {}
     for run_dir in argv:
         csv_path = os.path.join(run_dir, "returns.csv")
-        if os.path.exists(csv_path):
-            runs[os.path.basename(run_dir.rstrip("/"))] = load_returns_csv(csv_path)
-        else:
+        if not os.path.exists(csv_path):
             print(f"skip {run_dir}: no returns.csv")
+            continue
+        steps, vals = load_returns_csv(csv_path, column=column)
+        if len(steps) == 0:
+            # e.g. --success against a pre-success-column CSV: surface it
+            # instead of silently plotting an empty axes
+            print(f"skip {run_dir}: no data in column {column}")
+            continue
+        runs[os.path.basename(run_dir.rstrip("/"))] = (steps, vals)
     if not runs:
         print("error: no run dir contained a returns.csv")
         raise SystemExit(1)
-    out = plot_runs(runs, out_path="returns.png")
+    out = plot_runs(
+        runs,
+        out_path="success.png" if success else "returns.png",
+        title="success rate" if success else "returns",
+        ylabel="eval success rate (EWMA)" if success else None,
+    )
     print(f"wrote {out}")
 
 
