@@ -70,6 +70,11 @@ def run_actor(
         # remote goal actor: whole episodes on one env, originals + HER
         # relabels streamed with the count_env_steps frame flag so the
         # learner's env-step counter stays honest
+        if cfg.num_envs > 1:
+            print(f"[{actor_id}] --her runs a SINGLE env per remote actor "
+                  f"(episode-granular HER relabeling); ignoring "
+                  f"--num_envs {cfg.num_envs}. Launch more actor processes "
+                  "for width.", flush=True)
         goal_env = make_env_fn(cfg, seed=cfg.seed)()
         actor = GoalActorWorker(
             actor_id, config, actor_cfg, goal_env,
@@ -139,7 +144,10 @@ def main(argv=None):
     p.add_argument("--weights_port", type=int, required=True)
     p.add_argument("--actor_id", default="remote-0")
     p.add_argument("--env", default="Pendulum-v1")
-    p.add_argument("--num_envs", type=int, default=4)
+    p.add_argument("--num_envs", type=int, default=4,
+                   help="vectorized env pool width; with --her 1 the remote "
+                        "actor always runs a single env (launch more actor "
+                        "processes for width)")
     p.add_argument("--n_steps", type=int, default=None,
                    help="n-step horizon (default: from the env preset)")
     p.add_argument("--seed", type=int, default=0)

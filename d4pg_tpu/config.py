@@ -88,6 +88,7 @@ class ExperimentConfig:
     # behavior (no normalization anywhere). Vector obs only (the pixel
     # encoder normalizes by /255). HER-recipe component for Fetch/Hand.
     normalize_obs: bool = False
+    normalize_clip: float = 5.0  # +-clip after standardization (HER paper)
     epsilon_0: float = 0.3  # random_process.py:11
     min_epsilon: float = 0.01
     epsilon_horizon: int = 5000
@@ -280,6 +281,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--random_eps", type=float, default=d.random_eps)
     _add_bool_flag(p, "normalize_obs", d.normalize_obs,
                    "running observation standardization")
+    p.add_argument("--normalize_clip", type=float, default=d.normalize_clip)
     p.add_argument("--ou_theta", type=float, default=d.ou_theta)
     p.add_argument("--ou_sigma", type=float, default=d.ou_sigma)
     p.add_argument("--ou_mu", type=float, default=d.ou_mu)

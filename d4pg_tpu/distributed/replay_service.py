@@ -194,6 +194,14 @@ class ReplayService:
                 continue
             try:
                 if self.obs_norm is not None:
+                    # Only obs rows feed the estimator; next_obs is
+                    # normalized but never folded in. The episode-FINAL
+                    # next_obs is thereby excluded — intentional: there is
+                    # no row-level marker for "truly final" here (done=1
+                    # tags every n-step fold of a terminal AND HER success
+                    # relabels mid-trajectory, so done-gating would weight
+                    # terminal-adjacent states 2-5x instead), and the
+                    # omission is one state in T per episode.
                     self.obs_norm.update(batch.obs)
                     batch = batch._replace(
                         obs=self.obs_norm.normalize(batch.obs),

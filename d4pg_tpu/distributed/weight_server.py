@@ -111,6 +111,8 @@ class WeightServer:
                         # the same stats the learner's replay rows use
                         flat["__norm_mean__"] = np.asarray(norm[0])
                         flat["__norm_std__"] = np.asarray(norm[1])
+                        if len(norm) > 2:  # clip radius travels with stats
+                            flat["__norm_clip__"] = np.float64(norm[2])
                     np.savez(
                         buf,
                         __version__=np.int64(version),
@@ -163,6 +165,8 @@ class WeightClient:
             self.step = int(z["__step__"])
             if "__norm_mean__" in z.files:
                 self.norm_stats = (z["__norm_mean__"], z["__norm_std__"])
+                if "__norm_clip__" in z.files:
+                    self.norm_stats += (float(z["__norm_clip__"]),)
         return version, _unflatten(flat)
 
     def close(self) -> None:

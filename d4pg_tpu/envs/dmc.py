@@ -95,8 +95,17 @@ class DMControlEnv:
             # trial. dm_control tasks draw all episode randomness from
             # task.random (dm_control.rl.control.Environment hands it to
             # initialize_episode), so swapping the RandomState is the whole
-            # seeding story.
-            self._env.task._random = np.random.RandomState(seed)
+            # seeding story. The attribute is private, so verify it exists
+            # before assigning — a dm_control rename must fail loudly (a
+            # silent setattr would de-seed every eval trial), falling back
+            # to a full rebuild through the public constructor.
+            if hasattr(self._env.task, "_random"):
+                self._env.task._random = np.random.RandomState(seed)
+            else:  # dm_control renamed the field: rebuild via the public API
+                self._env.close()
+                self._env = self._suite.load(
+                    self._domain, self._task, task_kwargs={"random": seed}
+                )
         ts = self._env.reset()
         return self._obs(ts), {}
 

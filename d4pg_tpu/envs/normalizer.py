@@ -102,9 +102,12 @@ class FrozenNormalizer:
         self.clip = float(clip)
         self.set(mean, std)
 
-    def set(self, mean: np.ndarray, std: np.ndarray) -> None:
+    def set(self, mean: np.ndarray, std: np.ndarray,
+            clip: float | None = None) -> None:
         self._mean = np.asarray(mean, np.float64)
         self._std = np.maximum(np.asarray(std, np.float64), 1e-8)
+        if clip is not None:
+            self.clip = float(clip)
 
     def normalize(self, x: np.ndarray) -> np.ndarray:
         out = (np.asarray(x, np.float64) - self._mean) / self._std

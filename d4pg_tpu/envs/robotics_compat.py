@@ -68,6 +68,51 @@ def _assets_root(src_dir: str) -> str:
     return root
 
 
+def _tree_fingerprint(root: str) -> bytes:
+    """Content fingerprint of the assets tree: path + (size, mtime) of every
+    XML under ``root``. Folding this into the shadow-dir tag means an
+    in-place package upgrade (same install path, new MJCF) gets a FRESH
+    mirror instead of being served stale patched XML — the mirror trusts
+    existing entries, so the tag must change whenever the sources do."""
+    parts = [root.encode()]
+    for cur, _dirs, files in sorted(os.walk(root)):
+        for name in sorted(files):
+            if not name.endswith(".xml"):
+                continue
+            path = os.path.join(cur, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            parts.append(
+                f"{os.path.relpath(path, root)}:{st.st_size}:{st.st_mtime_ns}".encode()
+            )
+    return b"\0".join(parts)
+
+
+def _prune_stale_mirrors(root_tag: str, keep: str) -> None:
+    """Remove this uid's mirrors of the SAME assets tree whose content tag
+    is superseded — each source change (package upgrade) mints a new tag,
+    and nothing else ever deletes the orphaned tree of patched XMLs +
+    symlinks. Mirrors of other trees (different ``root_tag``) may be in
+    concurrent use by sibling processes and are never touched."""
+    import glob
+    import shutil
+
+    pattern = os.path.join(
+        tempfile.gettempdir(),
+        f"d4pg-tpu-mjcf-compat-{os.getuid()}-{root_tag}-*",
+    )
+    for path in glob.glob(pattern):
+        if path == keep:
+            continue
+        try:
+            if os.lstat(path).st_uid == os.getuid():
+                shutil.rmtree(path, ignore_errors=True)
+        except OSError:
+            pass
+
+
 def _shadow_dir(src_dir: str) -> str:
     """Patched mirror of ``src_dir``: the whole assets tree is mirrored once
     (XMLs copied with apirate stripped, meshes/textures symlinked), and the
@@ -77,7 +122,14 @@ def _shadow_dir(src_dir: str) -> str:
     if cached is not None:
         return cached
     root = _assets_root(src_dir)
-    tag = hashlib.sha256(root.encode()).hexdigest()[:16]
+    # two-part tag: <root-path-hash>-<content-hash>. The content part makes
+    # an in-place package upgrade mint a fresh mirror (existing entries are
+    # trusted, so the tag must change whenever the sources do); the root
+    # part scopes stale-mirror pruning to THIS assets tree, so concurrent
+    # mirrors of other packages' trees are never touched.
+    root_tag = hashlib.sha256(root.encode()).hexdigest()[:12]
+    content_tag = hashlib.sha256(_tree_fingerprint(root)).hexdigest()[:12]
+    tag = f"{root_tag}-{content_tag}"
     # Per-uid, mode-0700, ownership-verified: the path is predictable, so
     # on a multi-user host another user could otherwise pre-create it and
     # have MuJoCo load attacker-controlled MJCF (existing entries are
@@ -86,6 +138,7 @@ def _shadow_dir(src_dir: str) -> str:
     shadow_root = os.path.join(
         tempfile.gettempdir(), f"d4pg-tpu-mjcf-compat-{os.getuid()}-{tag}"
     )
+    _prune_stale_mirrors(root_tag, keep=shadow_root)
     os.makedirs(shadow_root, mode=0o700, exist_ok=True)
     st = os.lstat(shadow_root)  # lstat: a planted symlink must not pass by
     # pointing at a directory the victim owns

@@ -262,7 +262,7 @@ def train(cfg: ExperimentConfig) -> dict:
                              "multi-host runtime yet")
         from d4pg_tpu.envs.normalizer import RunningMeanStd
 
-        obs_norm = RunningMeanStd(config.obs_dim)
+        obs_norm = RunningMeanStd(config.obs_dim, clip=cfg.normalize_clip)
     service = ReplayService(buffer, obs_norm=obs_norm)
 
     # --- io (process 0 owns all of it in multi-host mode) ----------------
@@ -318,7 +318,11 @@ def train(cfg: ExperimentConfig) -> dict:
     weights = WeightStore()
 
     def _norm_snapshot():
-        return obs_norm.stats() if obs_norm is not None else None
+        # (mean, std, clip): clip travels with the stats so remote actors
+        # standardize policy inputs bitwise-identically to the replay rows
+        # even under a non-default --normalize_clip.
+        return ((*obs_norm.stats(), obs_norm.clip)
+                if obs_norm is not None else None)
 
     weights.publish(
         state.actor_params if mesh is None else jax.device_get(state.actor_params),
