@@ -24,6 +24,8 @@ constant.
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
@@ -41,6 +43,39 @@ STEPS = 320
 RECORDED_BASELINE_SPS = 39.6
 
 
+def _bench_config():
+    """THE benchmark model shape, shared by every path below AND by the
+    MFU numerator — measuring throughput of one shape and FLOPs of
+    another would silently corrupt the MFU."""
+    from d4pg_tpu.learner import D4PGConfig
+
+    return D4PGConfig(obs_dim=OBS_DIM, act_dim=ACT_DIM, v_min=0.0,
+                      v_max=800.0, n_atoms=N_ATOMS, hidden=(256, 256, 256),
+                      compute_dtype="bfloat16")
+
+
+def _random_batch(rng, prefix: tuple):
+    """A TransitionBatch of random rows with leading dims ``prefix``."""
+    from d4pg_tpu.replay.uniform import TransitionBatch
+
+    return TransitionBatch(
+        obs=rng.standard_normal((*prefix, OBS_DIM)).astype(np.float32),
+        action=rng.uniform(-1, 1, (*prefix, ACT_DIM)).astype(np.float32),
+        reward=rng.standard_normal(prefix).astype(np.float32),
+        next_obs=rng.standard_normal((*prefix, OBS_DIM)).astype(np.float32),
+        done=np.zeros(prefix, np.float32),
+        discount=np.full(prefix, 0.99, np.float32),
+    )
+
+
+def _fill(buffer, capacity: int, rng, drain: bool = False) -> None:
+    chunk = 4096
+    for _ in range(capacity // chunk):
+        buffer.add(_random_batch(rng, (chunk,)))
+        if drain:
+            buffer.drain()
+
+
 def bench_tpu(k: int = 16) -> float:
     """Learner grad-steps/sec with the production K-updates-per-dispatch
     path (``make_multi_update``; the single-dispatch step is dispatch-bound
@@ -48,25 +83,14 @@ def bench_tpu(k: int = 16) -> float:
     import jax
     import jax.numpy as jnp
 
-    from d4pg_tpu.learner import D4PGConfig, init_state, make_multi_update
-    from d4pg_tpu.replay.uniform import TransitionBatch
+    from d4pg_tpu.learner import init_state, make_multi_update
 
-    config = D4PGConfig(obs_dim=OBS_DIM, act_dim=ACT_DIM, v_min=0.0,
-                        v_max=800.0, n_atoms=N_ATOMS, hidden=(256, 256, 256),
-                        compute_dtype="bfloat16")
+    config = _bench_config()
     state = init_state(config, jax.random.key(0))
     update = make_multi_update(config, donate=True, use_is_weights=True)
 
     rng = np.random.default_rng(0)
-    batch = TransitionBatch(
-        obs=rng.standard_normal((k, BATCH, OBS_DIM)).astype(np.float32),
-        action=rng.uniform(-1, 1, (k, BATCH, ACT_DIM)).astype(np.float32),
-        reward=rng.standard_normal((k, BATCH)).astype(np.float32),
-        next_obs=rng.standard_normal((k, BATCH, OBS_DIM)).astype(np.float32),
-        done=np.zeros((k, BATCH), np.float32),
-        discount=np.full((k, BATCH), 0.99, np.float32),
-    )
-    batch = jax.device_put(batch)
+    batch = jax.device_put(_random_batch(rng, (k, BATCH)))
     weights = jax.device_put(jnp.ones((k, BATCH), jnp.float32))
 
     # warmup/compile
@@ -89,14 +113,11 @@ def bench_end_to_end(k: int = 16, capacity: int = 200_000,
     ``train.py`` ships (the host samples chunk t+1 while the device runs
     chunk t; priorities land with staleness <= 2K)."""
     import jax
-    from d4pg_tpu.learner import D4PGConfig, init_state, make_multi_update
+    from d4pg_tpu.learner import init_state, make_multi_update
     from d4pg_tpu.learner.pipeline import ChunkPipeline
     from d4pg_tpu.replay import LinearSchedule, PrioritizedReplayBuffer
-    from d4pg_tpu.replay.uniform import TransitionBatch
 
-    config = D4PGConfig(obs_dim=OBS_DIM, act_dim=ACT_DIM, v_min=0.0,
-                        v_max=800.0, n_atoms=N_ATOMS, hidden=(256, 256, 256),
-                        compute_dtype="bfloat16")
+    config = _bench_config()
     state = init_state(config, jax.random.key(0))
     update = make_multi_update(config, donate=True, use_is_weights=True)
     # shipped default (train.py 'auto'): ring in HBM on an accelerator,
@@ -105,19 +126,7 @@ def bench_end_to_end(k: int = 16, capacity: int = 200_000,
     buffer = PrioritizedReplayBuffer(capacity, OBS_DIM, ACT_DIM, alpha=0.6,
                                      storage=storage)
     beta = LinearSchedule(100_000, 1.0, 0.4)
-
-    rng = np.random.default_rng(0)
-    chunk = 4096
-    for _ in range(capacity // chunk):
-        done = np.zeros(chunk, np.float32)
-        buffer.add(TransitionBatch(
-            obs=rng.standard_normal((chunk, OBS_DIM)).astype(np.float32),
-            action=rng.uniform(-1, 1, (chunk, ACT_DIM)).astype(np.float32),
-            reward=rng.standard_normal(chunk).astype(np.float32),
-            next_obs=rng.standard_normal((chunk, OBS_DIM)).astype(np.float32),
-            done=done,
-            discount=np.full(chunk, 0.99, np.float32),
-        ))
+    _fill(buffer, capacity, np.random.default_rng(0))
 
     lstep = 0
 
@@ -155,28 +164,14 @@ def bench_fused(k: int = 40, capacity: int = 200_000,
     device."""
     import jax
 
-    from d4pg_tpu.learner import D4PGConfig, init_state
+    from d4pg_tpu.learner import init_state
     from d4pg_tpu.learner.fused import make_fused_chunk
     from d4pg_tpu.replay.fused_buffer import FusedDeviceReplay
-    from d4pg_tpu.replay.uniform import TransitionBatch
 
-    config = D4PGConfig(obs_dim=OBS_DIM, act_dim=ACT_DIM, v_min=0.0,
-                        v_max=800.0, n_atoms=N_ATOMS, hidden=(256, 256, 256),
-                        compute_dtype="bfloat16")
+    config = _bench_config()
     state = init_state(config, jax.random.key(0))
     buffer = FusedDeviceReplay(capacity, OBS_DIM, ACT_DIM, alpha=0.6)
-    rng = np.random.default_rng(0)
-    chunk = 4096
-    for _ in range(capacity // chunk):
-        buffer.add(TransitionBatch(
-            obs=rng.standard_normal((chunk, OBS_DIM)).astype(np.float32),
-            action=rng.uniform(-1, 1, (chunk, ACT_DIM)).astype(np.float32),
-            reward=rng.standard_normal(chunk).astype(np.float32),
-            next_obs=rng.standard_normal((chunk, OBS_DIM)).astype(np.float32),
-            done=np.zeros(chunk, np.float32),
-            discount=np.full(chunk, 0.99, np.float32),
-        ))
-        buffer.drain()
+    _fill(buffer, capacity, np.random.default_rng(0), drain=True)
     fn = make_fused_chunk(config, k=k, batch_size=BATCH, prioritized=True,
                           alpha=0.6, donate=True)
 
@@ -190,6 +185,50 @@ def bench_fused(k: int = 40, capacity: int = 200_000,
                                     buffer.size)
     jax.block_until_ready(m["critic_loss"])
     return n_dispatch * k / (time.perf_counter() - t0)
+
+
+def model_flops_per_step() -> float | None:
+    """XLA-reported FLOPs of ONE update step at the bench shape (B=256,
+    Humanoid-sized nets) — the MFU numerator. Uses the compiler's own cost
+    analysis of the jitted single-step update (all four network passes,
+    both backward passes, projection, Adam, soft target updates), the same
+    convention as model-FLOPs-based LLM MFU: replay machinery around the
+    update does not count as model compute."""
+    import jax
+
+    from d4pg_tpu.learner import init_state, make_update
+
+    config = _bench_config()
+    state = init_state(config, jax.random.key(0))
+    update = make_update(config, donate=False, use_is_weights=True)
+    batch = _random_batch(np.random.default_rng(0), (BATCH,))
+    w = np.ones((BATCH,), np.float32)
+    try:
+        compiled = update.lower(state, batch, w).compile()
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else ca
+        flops = float(ca["flops"])
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+# bf16 peak FLOPs/sec by TPU generation (public numbers); MFU is only
+# emitted when the device kind maps to one of these.
+_PEAK_BF16 = (
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
+    ("v6", 918e12), ("trillium", 918e12), ("v4", 275e12), ("v3", 123e12),
+)
+
+
+def peak_flops_per_sec() -> float | None:
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for sub, peak in _PEAK_BF16:
+        if sub in kind:
+            return peak
+    return None
 
 
 def bench_reference_torch_cpu(steps: int = 20) -> float | None:
@@ -254,12 +293,98 @@ def bench_reference_torch_cpu(steps: int = 20) -> float | None:
     return steps / (time.perf_counter() - t0)
 
 
+def bench_sharded_overhead(shard_counts=(1, 2, 4, 8), k: int = 8,
+                           capacity_per_shard: int = 8192,
+                           steps: int = 64) -> dict:
+    """Per-step cost of the replay-sharded fused path vs single-device
+    fused (VERDICT r2 #8): what the ``shard_map`` sampling prologue +
+    ``lax.pmin`` global IS-weight normalizer + per-shard priority
+    write-back cost per step as the mesh widens.
+
+    Runs on whatever devices are visible; the committed table uses 8
+    VIRTUAL CPU devices (``xla_force_host_platform_device_count``), which
+    prices dispatch structure and collective count honestly but NOT real
+    ICI latency — labeled as such where the numbers are reported.
+    """
+    import jax
+
+    from d4pg_tpu.learner import init_state
+    from d4pg_tpu.learner.fused import make_sharded_fused_chunk
+    from d4pg_tpu.parallel.mesh import MeshSpec, make_mesh
+    from d4pg_tpu.replay.sharded_per import ShardedFusedReplay
+
+    config = _bench_config()
+    rng = np.random.default_rng(0)
+    results = {}
+    for n in shard_counts:
+        if n > len(jax.devices()):
+            continue
+        mesh = make_mesh(MeshSpec(data_parallel=n),
+                         devices=jax.devices()[:n])
+        capacity = capacity_per_shard * n
+        buf = ShardedFusedReplay(capacity, OBS_DIM, ACT_DIM, mesh,
+                                 alpha=0.6)
+        _fill(buf, capacity, rng, drain=True)
+        state = init_state(config, jax.random.key(0))
+        fn = make_sharded_fused_chunk(config, mesh, k=k, batch_size=BATCH,
+                                      alpha=0.6, donate=False)
+        state, trees, m = fn(state, buf.trees, buf.storage, buf.size)
+        jax.block_until_ready(m["critic_loss"])  # warmup/compile
+        n_dispatch = max(1, steps // k)
+        t0 = time.perf_counter()
+        for _ in range(n_dispatch):
+            state, trees, m = fn(state, trees, buf.storage, buf.size)
+        jax.block_until_ready(m["critic_loss"])
+        dt = time.perf_counter() - t0
+        results[str(n)] = {
+            "steps_per_sec": round(n_dispatch * k / dt, 2),
+            "ms_per_step": round(1e3 * dt / (n_dispatch * k), 3),
+        }
+    one = results.get("1", {}).get("ms_per_step")
+    for n, row in results.items():
+        if one:
+            row["overhead_vs_1shard"] = round(row["ms_per_step"] / one, 2)
+    return results
+
+
 def main():
+    if "--sharded-overhead" in sys.argv:
+        # needs its own process: the device count must be fixed BEFORE
+        # backend init, so re-exec with virtual CPU devices unless the
+        # caller already set them up
+        if os.environ.get("D4PG_BENCH_SHARDED_CHILD") != "1":
+            import subprocess
+
+            env = dict(os.environ)
+            env["D4PG_BENCH_SHARDED_CHILD"] = "1"
+            flags = env.get("XLA_FLAGS", "")
+            if "host_platform_device_count" not in flags:
+                env["XLA_FLAGS"] = (
+                    f"{flags} --xla_force_host_platform_device_count=8".strip()
+                )
+            raise SystemExit(subprocess.call(
+                [sys.executable, os.path.abspath(__file__),
+                 "--sharded-overhead"], env=env,
+            ))
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        out = {
+            "metric": "sharded_replay_overhead",
+            "unit": "ms/step",
+            "backend": "virtual-cpu-devices",
+            "shards": bench_sharded_overhead(),
+        }
+        print(json.dumps(out))
+        return
+
     backend = ensure_backend(timeout=180.0)
     device_only = bench_tpu()
     fused = bench_fused()
     host_pipeline = bench_end_to_end()
     baseline = bench_reference_torch_cpu() or RECORDED_BASELINE_SPS
+    flops = model_flops_per_step()
+    peak = peak_flops_per_sec() if backend == "accel" else None
     out = {
         "metric": "learner_grad_steps_per_sec_end_to_end",
         "value": round(fused, 2),
@@ -268,12 +393,36 @@ def main():
         "device_only": round(device_only, 2),
         "host_pipeline_e2e": round(host_pipeline, 2),
         "baseline_torch_cpu": round(baseline, 2),
+        "model_flops_per_step": flops,
+        # model-FLOPs MFU of the headline fused rate: rate x per-step
+        # FLOPs / chip peak (bf16). Null off-accelerator or on unknown
+        # device kinds. D4PG at B=256/256-wide MLPs is latency-bound, not
+        # FLOP-bound, so single-digit percentages are expected and fine —
+        # the number exists to say so quantitatively (VERDICT r2 #2).
+        "mfu": (round(flops * fused / peak, 4) if flops and peak else None),
     }
     if backend != "accel":
         out["note"] = (f"{describe(backend)}; measured on the CPU backend — "
                        "TPU numbers are ~3 orders higher (see README "
                        "Performance)")
+    else:
+        # a live accelerator measurement is rare under the wedge-prone
+        # tunnel: persist the raw artifact so the claim is reproducible
+        # evidence (VERDICT r2 #1)
+        evidence = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "docs", "evidence", "bench")
+        os.makedirs(evidence, exist_ok=True)
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        with open(os.path.join(evidence, f"bench_accel_{stamp}.json"),
+                  "w") as f:
+            json.dump({**out, "device_kind": _device_kind()}, f, indent=2)
     print(json.dumps(out))
+
+
+def _device_kind() -> str:
+    import jax
+
+    return jax.devices()[0].device_kind
 
 
 if __name__ == "__main__":

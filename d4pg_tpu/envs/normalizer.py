@@ -52,11 +52,20 @@ class RunningMeanStd:
             return
         b_mean = batch.mean(axis=0)
         b_m2 = ((batch - b_mean) ** 2).sum(axis=0)
+        self.merge(n, b_mean, b_m2)
+
+    def merge(self, count: float, mean: np.ndarray, m2: np.ndarray) -> None:
+        """Chan-merge another estimator's (count, mean, M2) moments into
+        this one — the same parallel-Welford combine ``update`` uses for a
+        batch, exposed for cross-host statistic aggregation."""
+        if count <= 0:
+            return
         with self._lock:
-            total = self._count + n
-            delta = b_mean - self._mean
-            self._mean = self._mean + delta * (n / total)
-            self._m2 = self._m2 + b_m2 + delta**2 * (self._count * n / total)
+            total = self._count + count
+            delta = np.asarray(mean, np.float64) - self._mean
+            self._mean = self._mean + delta * (count / total)
+            self._m2 = (self._m2 + np.asarray(m2, np.float64)
+                        + delta**2 * (self._count * count / total))
             self._count = total
 
     def stats(self) -> tuple[np.ndarray, np.ndarray]:
@@ -91,6 +100,40 @@ class RunningMeanStd:
             self._m2 = np.asarray(d["m2"], np.float64).copy()
             self.clip = float(d.get("clip", self.clip))
             self.eps = float(d.get("eps", self.eps))
+
+
+class SyncedRunningMeanStd(RunningMeanStd):
+    """Multi-host variant (the HER paper's MPI-averaged normalization, as
+    one allgather): each host's replay drain folds ONLY into a local
+    *delta* estimator; :meth:`sync` — called at a point every process
+    reaches in lockstep (the cycle boundary) — allgathers the deltas and
+    merges them into the global statistics in process order, leaving all
+    hosts with bitwise-identical stats. ``normalize``/``stats``/checkpoint
+    payload read the global estimator, so replay rows and acting inputs
+    are standardized identically on every host (stats at most one cycle
+    stale, same drift bound as the single-host replay normalizer)."""
+
+    def __init__(self, dim: int, clip: float = 5.0, eps: float = 1e-2):
+        super().__init__(dim, clip, eps)
+        self._delta = RunningMeanStd(dim, clip, eps)
+
+    def update(self, batch: np.ndarray) -> None:
+        self._delta.update(batch)
+
+    def sync(self) -> None:
+        """Collective: every process MUST call this at the same point."""
+        from jax.experimental import multihost_utils
+
+        d = self._delta
+        with d._lock:
+            payload = np.concatenate(
+                [[d._count], d._mean, d._m2]).astype(np.float64)
+            d._count = 0.0
+            d._mean = np.zeros(self.dim, np.float64)
+            d._m2 = np.zeros(self.dim, np.float64)
+        gathered = np.asarray(multihost_utils.process_allgather(payload))
+        for row in gathered.reshape(-1, 1 + 2 * self.dim):  # process order
+            self.merge(row[0], row[1:1 + self.dim], row[1 + self.dim:])
 
 
 class FrozenNormalizer:

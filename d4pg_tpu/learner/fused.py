@@ -154,11 +154,11 @@ def make_sharded_fused_chunk(
     from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from d4pg_tpu.parallel.data_parallel import _reject_pallas
+    from d4pg_tpu.parallel.data_parallel import check_mesh_compatible
     from d4pg_tpu.parallel.mesh import DATA_AXIS
     from d4pg_tpu.replay.sharded_per import ShardedPerTrees
 
-    _reject_pallas(config)
+    check_mesh_compatible(config)
 
     n_shards = int(mesh.shape[DATA_AXIS])
     if batch_size % n_shards:

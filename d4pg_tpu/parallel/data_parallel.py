@@ -60,7 +60,7 @@ def shard_stacked(batches, mesh: Mesh):
     return jax.device_put(batches, stacked_sharding(mesh))
 
 
-def _reject_pallas(config: D4PGConfig) -> None:
+def check_mesh_compatible(config: D4PGConfig) -> None:
     """The Pallas projection kernel has no GSPMD partitioning rule — under
     a sharded jit it would fail to compile or silently all-gather the
     batch onto every device. Mesh learners must use the einsum
@@ -86,7 +86,7 @@ def make_sharded_update(
     ``td_error`` sharded over ``data`` (it flows back to the host PER
     priority update, ``ddpg.py:252-255``).
     """
-    _reject_pallas(config)
+    check_mesh_compatible(config)
     repl = _replicated(mesh)
     shard = _batch_sharding(mesh)
 
@@ -128,7 +128,7 @@ def make_sharded_multi_update(
     ``P(None, 'data')``. out: state replicated, scalar metrics stacked [K]
     replicated, ``td_error`` [K, B] sharded ``P(None, 'data')``.
     """
-    _reject_pallas(config)
+    check_mesh_compatible(config)
     repl = _replicated(mesh)
     stacked = stacked_sharding(mesh)
     out_metrics = {

@@ -123,6 +123,48 @@ def infer_dims(cfg: ExperimentConfig) -> tuple[int | tuple, int, np.dtype]:
     return obs_dim, act_dim, obs_dtype
 
 
+def _host_replay_path(run_dir: str, process_index: int) -> str:
+    return os.path.join(run_dir, f"replay_p{process_index}.pkl")
+
+
+def _save_host_replay(run_dir: str, process_index: int, step: int,
+                      snap: dict) -> None:
+    """Sidecar replay-shard snapshot for multi-host hosts > 0 (process 0's
+    shard rides the Orbax ``extra`` payload). Stamped with the learner
+    step it was taken at so resume can refuse a shard from a different
+    training moment than the restored state. Write-then-rename so a crash
+    mid-save leaves the previous snapshot intact."""
+    import pickle
+
+    path = _host_replay_path(run_dir, process_index)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump({"step": int(step), "snap": snap},
+                    f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)
+
+
+def _load_host_replay(run_dir: str, process_index: int,
+                      step: int) -> dict | None:
+    """Load this host's replay sidecar IF it matches the restored learner
+    step — a shard from another save moment (e.g. the state checkpoint is
+    newer than the last replay-due save) would silently mix replay
+    timelines across hosts."""
+    import pickle
+
+    path = _host_replay_path(run_dir, process_index)
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    if int(payload.get("step", -1)) != int(step):
+        print(f"[p{process_index}] replay sidecar is from step "
+              f"{payload.get('step')} but the restored state is at step "
+              f"{step}; starting with an empty shard", flush=True)
+        return None
+    return payload["snap"]
+
+
 def train(cfg: ExperimentConfig) -> dict:
     cfg = cfg.resolve()
     if cfg.platform == "cpu":
@@ -137,8 +179,9 @@ def train(cfg: ExperimentConfig) -> dict:
     multi_host = jax.process_count() > 1
     is_main = jax.process_index() == 0
     run_dir = os.path.join(cfg.log_dir, cfg.run_name())
-    if is_main:
-        os.makedirs(run_dir, exist_ok=True)
+    # every process may write here (multi-host hosts > 0 put their replay
+    # sidecar snapshots in the run dir)
+    os.makedirs(run_dir, exist_ok=True)
 
     obs_dim, act_dim, obs_dtype = infer_dims(cfg)
     config = cfg.learner_config(obs_dim, act_dim)
@@ -257,12 +300,17 @@ def train(cfg: ExperimentConfig) -> dict:
                              "the pixel encoder already normalizes by /255")
         if multi_host:
             # per-host stats would normalize each host's replay rows
-            # differently under globally-shared params
-            raise ValueError("--normalize_obs is not supported with the "
-                             "multi-host runtime yet")
-        from d4pg_tpu.envs.normalizer import RunningMeanStd
+            # differently under globally-shared params; the synced variant
+            # allgather-merges per-cycle deltas so every host standardizes
+            # with identical statistics (HER paper's MPI-averaged stats)
+            from d4pg_tpu.envs.normalizer import SyncedRunningMeanStd
 
-        obs_norm = RunningMeanStd(config.obs_dim, clip=cfg.normalize_clip)
+            obs_norm = SyncedRunningMeanStd(config.obs_dim,
+                                            clip=cfg.normalize_clip)
+        else:
+            from d4pg_tpu.envs.normalizer import RunningMeanStd
+
+            obs_norm = RunningMeanStd(config.obs_dim, clip=cfg.normalize_clip)
     service = ReplayService(buffer, obs_norm=obs_norm)
 
     # --- io (process 0 owns all of it in multi-host mode) ----------------
@@ -284,10 +332,86 @@ def train(cfg: ExperimentConfig) -> dict:
             active_processes={0} if multi_host else None)
     extra: dict = {"env_steps": 0}
     if cfg.resume and multi_host:
-        raise ValueError(
-            "--resume is not supported with the multi-host runtime yet; "
-            "restore single-host, then relaunch distributed")
-    if cfg.resume and ckpt is not None and ckpt.latest_step is not None:
+        # Restore on process 0, broadcast, re-replicate over the global
+        # mesh; every host then loads ITS OWN replay shard snapshot
+        # (process 0's rides the Orbax extra payload, hosts > 0 write
+        # sidecar files — see the save site below).
+        from jax.experimental import multihost_utils
+
+        def _state_raw(s):
+            # typed PRNG keys don't cross the allgather; carry raw key data
+            d = s._asdict()
+            d["key"] = jax.random.key_data(d["key"])
+            return jax.tree_util.tree_map(np.asarray, d)
+
+        host_state = jax.device_get(state)  # replicated -> host template
+        if is_main and ckpt is not None and ckpt.latest_step is not None:
+            restored, extra = ckpt.restore(host_state)
+            raw, found = _state_raw(restored), 1
+        else:
+            raw, found = _state_raw(host_state), 0
+        found = int(multihost_utils.broadcast_one_to_all(np.int32(found)))
+        if found:
+            raw = multihost_utils.broadcast_one_to_all(raw)
+
+            def _rebuild():
+                d = {k: jax.tree_util.tree_map(jnp.asarray, v)
+                     for k, v in raw.items()}
+                d["key"] = jax.random.wrap_key_data(jnp.asarray(raw["key"]))
+                from d4pg_tpu.learner.state import D4PGState
+
+                return D4PGState(**d)
+
+            state = multihost.replicate_state_global(_rebuild, mesh)
+            env_steps = int(multihost_utils.broadcast_one_to_all(
+                np.int64(extra.get("env_steps", 0))))
+            extra["env_steps"] = env_steps
+            service.set_env_steps(env_steps)
+            # normalize-flag agreement must be decided identically on ALL
+            # hosts before any further collective: a process-0-only raise
+            # would leave the other hosts hung in the next barrier
+            has_norm = int(multihost_utils.broadcast_one_to_all(
+                np.int32(1 if extra.get("obs_norm") else 0)))
+            if has_norm and obs_norm is None:
+                raise ValueError(
+                    "checkpoint was trained with --normalize_obs (its "
+                    "policy and replay rows live in normalized space); "
+                    "resume with the flag")
+            if obs_norm is not None:
+                if not has_norm and env_steps > 0:
+                    raise ValueError(
+                        "--normalize_obs resume from a checkpoint without "
+                        "obs_norm statistics: the restored policy/replay "
+                        "are in raw space — resume without the flag, or "
+                        "restart training")
+                if has_norm:
+                    # fixed-shape stats payload -> identical estimators
+                    d = (extra.get("obs_norm")
+                         or {"count": 0.0,
+                             "mean": np.zeros(config.obs_dim),
+                             "m2": np.zeros(config.obs_dim),
+                             "clip": cfg.normalize_clip, "eps": 1e-2})
+                    payload = np.concatenate(
+                        [[d["count"]], d["mean"], d["m2"],
+                         [d["clip"], d["eps"]]]).astype(np.float64)
+                    payload = np.asarray(
+                        multihost_utils.broadcast_one_to_all(payload))
+                    n = config.obs_dim
+                    extra["obs_norm"] = {
+                        "count": float(payload[0]), "mean": payload[1:1 + n],
+                        "m2": payload[1 + n:1 + 2 * n],
+                        "clip": float(payload[-2]), "eps": float(payload[-1]),
+                    }
+            restored_step = int(np.asarray(raw["step"]))
+            snap = (extra.pop("replay", None) if is_main
+                    else _load_host_replay(run_dir, jax.process_index(),
+                                           restored_step))
+            if snap:
+                service.load_replay_state(snap)
+            print(f"[p{jax.process_index()}] resumed from step "
+                  f"{int(jax.device_get(state.step))} ({service.env_steps} "
+                  f"env steps, {len(service)} replay rows)", flush=True)
+    elif cfg.resume and ckpt is not None and ckpt.latest_step is not None:
         state, extra = ckpt.restore(state if mesh is None else jax.device_get(state))
         if mesh is not None:
             state = replicate_state(state, mesh)
@@ -415,7 +539,9 @@ def train(cfg: ExperimentConfig) -> dict:
             "127.0.0.1" if cfg.serve_host in ("0.0.0.0", "127.0.0.1")
             else cfg.serve_host
         )
-        for i in range(cfg.actor_procs):
+        def spawn_actor_proc(i: int):
+            # stateless by design (replay + weights live with the learner),
+            # so the supervisor can respawn with the same config/identity
             proc_cfg = dataclasses.replace(
                 cfg, seed=cfg.seed + 1000 * (i + 1), actor_procs=0,
                 serve=False)
@@ -427,7 +553,10 @@ def train(cfg: ExperimentConfig) -> dict:
                 daemon=True,
             )
             p.start()
-            actor_processes.append(p)
+            return p
+
+        for i in range(cfg.actor_procs):
+            actor_processes.append(spawn_actor_proc(i))
         print(f"spawned {len(actor_processes)} actor processes", flush=True)
         if cfg.n_workers == 0:
             # no in-process actors: wait for the fleet to fill the warmup
@@ -446,6 +575,10 @@ def train(cfg: ExperimentConfig) -> dict:
         weights.publish(p, step=lstep, norm_stats=_norm_snapshot())
 
     if obs_norm is not None:
+        if multi_host:
+            # fold every host's warmup rows into the shared statistics
+            # before anything trains or republishes (collective)
+            obs_norm.sync()
         # warmup just populated the statistics; remote/spawned actors built
         # their FrozenNormalizer from the count-0 pre-warmup publish and
         # won't see a newer weight version until training publishes —
@@ -704,6 +837,10 @@ def train(cfg: ExperimentConfig) -> dict:
                             1, cfg.num_envs)
                         actor.run(ticks)
                 service.flush()
+            if multi_host and obs_norm is not None:
+                # collective: merge every host's normalizer delta so all
+                # hosts standardize with identical statistics this cycle
+                obs_norm.sync()
             # train (trace the first cycle when profiling is enabled)
             timer.start()
             if epoch == 0 and cycle == 0 and cfg.profile_dir:
@@ -744,27 +881,47 @@ def train(cfg: ExperimentConfig) -> dict:
             if rate is not None:
                 last_metrics["grad_steps_per_sec"] = round(rate, 2)
             last_metrics["cycle_time_s"] = round(time.monotonic() - cycle_t0, 4)
+            # Failure detection/recovery (SURVEY.md §5): stale heartbeats
+            # reach the metrics bus (not just stdout); dead spawned actor
+            # PROCESSES are respawned like dead threads — they are
+            # stateless, replay and weights live with the learner. Remote
+            # actors (other machines) can only be observed, not respawned.
             dead = service.dead_actors()
+            last_metrics["dead_actors"] = len(dead)
             if dead:
                 print(f"WARNING: actors missing heartbeats: {dead}", flush=True)
+            for i, p in enumerate(actor_processes):
+                if not p.is_alive():
+                    print(f"supervisor: restarting actor process {i} "
+                          f"(exitcode {p.exitcode})", flush=True)
+                    actor_processes[i] = spawn_actor_proc(i)
             if cfg.async_actors:
                 supervise_actors()
             bus.log(lstep, last_metrics)
-            if ckpt is not None and (cycle + 1) % cfg.checkpoint_every == 0:
+            if (cycle + 1) % cfg.checkpoint_every == 0:
                 n_saves += 1
-                extra_payload = {"env_steps": service.env_steps}
-                if obs_norm is not None:
-                    extra_payload["obs_norm"] = obs_norm.state_dict()
-                if (cfg.checkpoint_replay
-                        and n_saves % max(1, cfg.checkpoint_replay_every) == 0):
-                    # coarser cadence than the state checkpoint: the ring
-                    # snapshot holds the buffer lock and (device storage)
-                    # pays a full D2H copy
-                    extra_payload["replay"] = service.replay_state()
-                ckpt.save(
-                    state if mesh is None else jax.device_get(state),
-                    extra=extra_payload,
-                )
+                replay_due = (
+                    cfg.checkpoint_replay
+                    and n_saves % max(1, cfg.checkpoint_replay_every) == 0)
+                if ckpt is not None:
+                    extra_payload = {"env_steps": service.env_steps}
+                    if obs_norm is not None:
+                        extra_payload["obs_norm"] = obs_norm.state_dict()
+                    if replay_due:
+                        # coarser cadence than the state checkpoint: the
+                        # ring snapshot holds the buffer lock and (device
+                        # storage) pays a full D2H copy
+                        extra_payload["replay"] = service.replay_state()
+                    ckpt.save(
+                        state if mesh is None else jax.device_get(state),
+                        extra=extra_payload,
+                    )
+                elif multi_host and replay_due:
+                    # hosts > 0: the learner state is process 0's to save
+                    # (it is replicated), but each host's replay shard is
+                    # its own — sidecar snapshot for multi-host resume
+                    _save_host_replay(run_dir, jax.process_index(), lstep,
+                                      service.replay_state())
     stop_actors.set()
     for t in actor_threads.values():
         t.join(timeout=10.0)

@@ -2,7 +2,13 @@
 process-level parallelism over the TCP plane, replacing the reference's
 mp.Process fan-out (main.py:399-405)."""
 
+import os
+
 import numpy as np
+
+import pytest
+
+pytestmark = pytest.mark.slow
 
 
 def test_train_with_spawned_actor_processes(tmp_path):
@@ -21,3 +27,44 @@ def test_train_with_spawned_actor_processes(tmp_path):
     assert np.isfinite(metrics["critic_loss"])
     # all data arrived from the spawned process over TCP
     assert metrics["env_steps"] >= 100
+
+
+def test_spawned_actor_process_respawned_on_death(tmp_path, capfd):
+    """VERDICT r2 #7: a dead --actor_procs child must be respawned by the
+    supervisor (same identity/config — actors are stateless), and actor
+    liveness must reach the metrics bus as ``dead_actors``."""
+    import glob
+    import multiprocessing as mp
+    import threading
+    import time
+
+    from d4pg_tpu.config import ExperimentConfig
+    from d4pg_tpu.train import train
+
+    cfg = ExperimentConfig(
+        env="point", max_steps=20, num_envs=2, warmup=100, n_epochs=1,
+        n_cycles=10, episodes_per_cycle=1, train_steps_per_cycle=8,
+        updates_per_dispatch=4, eval_trials=1, batch_size=16,
+        memory_size=5000, log_dir=str(tmp_path), hidden=(16, 16),
+        n_atoms=11, v_min=-5.0, v_max=0.0, n_workers=0, actor_procs=1,
+        async_actors=True,
+    )
+    result: dict = {}
+    t = threading.Thread(target=lambda: result.update(train(cfg)), daemon=True)
+    t.start()
+    # past warmup and into the cycle loop: the csv sink has logged a row
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        csvs = glob.glob(str(tmp_path / "exp_*" / "returns.csv"))
+        if csvs and os.path.getsize(csvs[0]) > 0 and mp.active_children():
+            break
+        time.sleep(0.2)
+    kids = mp.active_children()
+    assert kids, "spawned actor process not found"
+    kids[0].terminate()  # kill the actor mid-run
+    t.join(timeout=600)
+    assert not t.is_alive()
+    out = capfd.readouterr().out
+    assert "supervisor: restarting actor process 0" in out
+    assert "dead_actors" in result
+    assert np.isfinite(result["critic_loss"])

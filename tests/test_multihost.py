@@ -8,6 +8,10 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 
 def _free_port() -> int:
     s = socket.socket()
@@ -90,3 +94,58 @@ def test_two_processes_form_one_mesh():
     assert "mesh 8 devices" in oks[0]
     # replicas agree: both processes report identical losses
     assert oks[0].split("losses")[1] == oks[1].split("losses")[1]
+
+
+def test_two_process_resume_with_normalize(tmp_path):
+    """VERDICT r2 #6: the multi-host runtime must support --resume and
+    --normalize_obs. Run 1 trains with synced observation normalization
+    and per-cycle checkpoints (replay snapshots every save: process 0's in
+    the Orbax extra, process 1's as a sidecar file). Run 2 resumes: BOTH
+    processes must restore the broadcast state, their own replay shard,
+    and the shared normalizer statistics."""
+    env = _mh_env()
+    base = [
+        "--env", "point", "--max_steps", "20", "--num_envs", "2",
+        "--warmup", "100", "--n_eps", "1", "--n_cycles", "2",
+        "--episodes_per_cycle", "1", "--train_steps_per_cycle", "8",
+        "--updates_per_dispatch", "4", "--eval_trials", "1",
+        "--bsize", "16", "--rmsize", "2000", "--n_atoms", "11",
+        "--v_min", "-5.0", "--v_max", "0.0",
+        "--normalize_obs", "1", "--checkpoint_replay", "1",
+        "--checkpoint_replay_every", "1",
+        "--log_dir", str(tmp_path), "--num_processes", "2",
+    ]
+
+    def launch(extra_args):
+        port = _free_port()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m", "d4pg_tpu.train", *base, *extra_args,
+                 "--coordinator", f"127.0.0.1:{port}",
+                 "--process_id", str(i)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True,
+            )
+            for i in range(2)
+        ]
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+            assert p.returncode == 0, out[-3000:]
+        return outs
+
+    launch([])
+    # both hosts wrote their replay shard (p0 via Orbax extra, p1 sidecar)
+    run_dirs = [d for d in os.listdir(tmp_path) if d.startswith("exp_")]
+    assert len(run_dirs) == 1
+    assert os.path.exists(os.path.join(tmp_path, run_dirs[0], "replay_p1.pkl"))
+
+    outs = launch(["--resume", "1"])
+    for i, out in enumerate(outs):
+        assert f"[p{i}] resumed from step 16" in out, out[-3000:]
+    # resumed replay shards were non-empty on both hosts
+    import re
+
+    rows = [int(re.search(r"(\d+) replay rows", out).group(1)) for out in outs]
+    assert all(r > 0 for r in rows), rows

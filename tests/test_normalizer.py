@@ -108,3 +108,44 @@ def test_norm_stats_ride_the_weight_channel():
     finally:
         client.close()
         server.close()
+
+
+def test_synced_rms_single_process_matches_direct():
+    """SyncedRunningMeanStd.sync() (1-process allgather) must fold the
+    delta into the global stats exactly like a direct RunningMeanStd
+    update, and leave the delta empty."""
+    import numpy as np
+
+    from d4pg_tpu.envs.normalizer import RunningMeanStd, SyncedRunningMeanStd
+
+    rng = np.random.default_rng(0)
+    a, b = rng.standard_normal((64, 5)) * 3 + 1, rng.standard_normal((32, 5))
+    direct = RunningMeanStd(5)
+    synced = SyncedRunningMeanStd(5)
+    for chunk in (a, b):
+        direct.update(chunk)
+        synced.update(chunk)
+    assert synced.stats()[0].max() == 0  # global untouched before sync
+    synced.sync()
+    np.testing.assert_allclose(synced.stats()[0], direct.stats()[0], rtol=1e-12)
+    np.testing.assert_allclose(synced.stats()[1], direct.stats()[1], rtol=1e-12)
+    assert synced._delta._count == 0
+    synced.sync()  # empty delta: a second sync must be a no-op
+    np.testing.assert_allclose(synced.stats()[0], direct.stats()[0], rtol=1e-12)
+
+
+def test_rms_merge_matches_update():
+    import numpy as np
+
+    from d4pg_tpu.envs.normalizer import RunningMeanStd
+
+    rng = np.random.default_rng(1)
+    x, y = rng.standard_normal((40, 3)), rng.standard_normal((24, 3)) + 2
+    one = RunningMeanStd(3)
+    one.update(np.concatenate([x, y]))
+    left, right = RunningMeanStd(3), RunningMeanStd(3)
+    left.update(x)
+    right.update(y)
+    left.merge(right._count, right._mean, right._m2)
+    np.testing.assert_allclose(left.stats()[0], one.stats()[0], rtol=1e-12)
+    np.testing.assert_allclose(left.stats()[1], one.stats()[1], rtol=1e-12)
