@@ -32,7 +32,7 @@ from typing import NamedTuple
 import numpy as np
 
 from d4pg_tpu.replay.segment_tree import next_pow2
-from d4pg_tpu.replay.uniform import TransitionBatch, pack_rows, unpack_rows
+from d4pg_tpu.replay.uniform import TransitionBatch, pack_rows, validate_rows
 
 
 class ShardedPerTrees(NamedTuple):
@@ -265,8 +265,8 @@ class ShardedFusedReplay:
             raise ValueError(
                 "sharded replay checkpoint requires the same data-parallel "
                 f"degree (got {s['n_shards']}, have {self.n_shards})")
-        unpack_rows({k: v for k, v in d.items() if k != "sharded"}
-                    | {"size": 0, "head": 0}, self.capacity)
+        validate_rows({k: v for k, v in d.items() if k != "sharded"},
+                      self.capacity)
         shard = NamedSharding(self.mesh, P(DATA_AXIS))
         self.storage = jax.device_put(TransitionBatch(
             *[jnp.asarray(d["rows"][f]) for f in TransitionBatch._fields]),

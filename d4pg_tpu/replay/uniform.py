@@ -49,12 +49,11 @@ def pack_rows(rows: TransitionBatch, head: int, size: int,
     }
 
 
-def unpack_rows(d: dict, capacity: int):
-    """Validate + unpack a :func:`pack_rows` payload. Returns
-    ``(batch_or_None, head, size)``. Capacity must match exactly: a
-    wrapped ring re-laid into a different capacity leaves head/size
-    pointing at the wrong slots (live rows silently overwritten or
-    zero-garbage samples)."""
+def validate_rows(d: dict, capacity: int) -> None:
+    """Reject a :func:`pack_rows` payload whose layout cannot restore into
+    a ``capacity``-sized ring. Capacity must match exactly: a wrapped ring
+    re-laid into a different capacity leaves head/size pointing at the
+    wrong slots (live rows silently overwritten or zero-garbage samples)."""
     if "sharded" in d:
         raise ValueError(
             "replay checkpoint was saved by a sharded (data_parallel) "
@@ -64,6 +63,12 @@ def unpack_rows(d: dict, capacity: int):
         raise ValueError(
             f"replay checkpoint capacity {ckpt_cap} != buffer capacity "
             f"{capacity}; resume with the same --rmsize")
+
+
+def unpack_rows(d: dict, capacity: int):
+    """Validate + unpack a :func:`pack_rows` payload. Returns
+    ``(batch_or_None, head, size)``."""
+    validate_rows(d, capacity)
     size = int(d["size"])
     batch = (TransitionBatch(*[d["rows"][f] for f in TransitionBatch._fields])
              if size else None)
