@@ -31,6 +31,11 @@ class ExperimentConfig:
     num_envs: int = 4  # vectorized pool width (reference: 1)
     her: bool = False  # --her
     her_ratio: float = 0.8  # main.py:165
+    # pixel-obs rendering size (dm_control adapter) and conv-encoder width;
+    # the 84px/32ch DrQ defaults cost ~40 GFLOP per grad step — smaller
+    # settings make pixel training tractable on modest hosts
+    pixel_size: int = 84
+    encoder_width: int = 32
     reward_scale: float = 1.0
     # replay
     memory_size: int = 1_000_000  # --rmsize
@@ -222,6 +227,7 @@ class ExperimentConfig:
             hidden=tuple(self.hidden),
             critic_family=self.critic_family,
             projection=self.projection,
+            encoder_channels=(self.encoder_width,) * 4,
             lr_actor=self.lr_actor,
             lr_critic=self.lr_critic,
             adam_b1=self.adam_b1,
@@ -252,6 +258,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_envs", type=int, default=d.num_envs)
     _add_bool_flag(p, "her", d.her, "hindsight experience replay")
     p.add_argument("--her_ratio", type=float, default=d.her_ratio)
+    p.add_argument("--pixel_size", type=int, default=d.pixel_size,
+                   help="dm_control pixel render height/width")
+    p.add_argument("--encoder_width", type=int, default=d.encoder_width,
+                   help="conv-encoder channel width (4 layers)")
     p.add_argument("--rmsize", type=int, default=d.memory_size, dest="memory_size")
     p.add_argument("--bsize", type=int, default=d.batch_size, dest="batch_size")
     p.add_argument("--warmup", type=int, default=d.warmup)

@@ -63,6 +63,7 @@ class D4PGConfig:
     action_l2: float = 0.0
     pixels: bool = False  # conv-encoder path (BASELINE.md config #4)
     obs_shape: tuple = ()  # [H, W, C] when pixels=True
+    encoder_channels: tuple = (32, 32, 32, 32)  # conv widths (pixels only)
     mog_samples: int = 32
     # MXU compute dtype for the network matmuls ('float32' | 'bfloat16').
     # Params, optimizer state, losses and the projection stay float32;
@@ -79,6 +80,8 @@ class D4PGConfig:
     def __post_init__(self):
         object.__setattr__(self, "hidden", tuple(self.hidden))
         object.__setattr__(self, "obs_shape", tuple(self.obs_shape))
+        object.__setattr__(self, "encoder_channels",
+                           tuple(self.encoder_channels))
         if self.critic_family not in ("categorical", "mog"):
             raise ValueError(f"unknown critic_family {self.critic_family!r}")
         if self.compute_dtype not in ("float32", "bfloat16"):
@@ -101,7 +104,8 @@ class D4PGConfig:
 
     def build_actor(self) -> nn.Module:
         if self.pixels:
-            return PixelActor(self.act_dim, hidden=self.hidden, dtype=self._dtype)
+            return PixelActor(self.act_dim, channels=self.encoder_channels,
+                              hidden=self.hidden, dtype=self._dtype)
         return Actor(self.act_dim, hidden=self.hidden, dtype=self._dtype)
 
     def build_critic(self) -> nn.Module:
@@ -111,7 +115,8 @@ class D4PGConfig:
             )
         if self.pixels:
             return PixelCategoricalCritic(
-                self.n_atoms, hidden=self.hidden, dtype=self._dtype
+                self.n_atoms, channels=self.encoder_channels,
+                hidden=self.hidden, dtype=self._dtype
             )
         return CategoricalCritic(self.n_atoms, hidden=self.hidden, dtype=self._dtype)
 

@@ -49,12 +49,14 @@ class PixelActor(nn.Module):
 
     act_dim: int
     latent_dim: int = 50
+    channels: Sequence[int] = (32, 32, 32, 32)
     hidden: Sequence[int] = (256, 256, 256)
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, pixels: jnp.ndarray) -> jnp.ndarray:
-        z = PixelEncoder(self.latent_dim, dtype=self.dtype, name="encoder")(pixels)
+        z = PixelEncoder(self.latent_dim, tuple(self.channels),
+                         dtype=self.dtype, name="encoder")(pixels)
         return Actor(self.act_dim, self.hidden, dtype=self.dtype, name="actor")(z)
 
 
@@ -63,6 +65,7 @@ class PixelCategoricalCritic(nn.Module):
 
     n_atoms: int = 51
     latent_dim: int = 50
+    channels: Sequence[int] = (32, 32, 32, 32)
     hidden: Sequence[int] = (256, 256, 256)
     dtype: jnp.dtype = jnp.float32
 
@@ -70,6 +73,7 @@ class PixelCategoricalCritic(nn.Module):
     def __call__(
         self, pixels: jnp.ndarray, action: jnp.ndarray, return_logits: bool = False
     ) -> jnp.ndarray:
-        z = PixelEncoder(self.latent_dim, dtype=self.dtype, name="encoder")(pixels)
+        z = PixelEncoder(self.latent_dim, tuple(self.channels),
+                         dtype=self.dtype, name="encoder")(pixels)
         return CategoricalCritic(self.n_atoms, self.hidden, dtype=self.dtype,
                                  name="critic")(z, action, return_logits)

@@ -74,7 +74,9 @@ def make_env_fn(cfg: ExperimentConfig, seed: int):
     dmc = parse_dmc_id(cfg.env)
     if dmc is not None:
         domain, task, pixels = dmc
-        return lambda: DMControlEnv(domain, task, pixels=pixels, seed=seed)
+        return lambda: DMControlEnv(domain, task, pixels=pixels, seed=seed,
+                                    height=cfg.pixel_size,
+                                    width=cfg.pixel_size)
     import gymnasium as gym
 
     def make():
