@@ -888,7 +888,13 @@ def train(cfg: ExperimentConfig) -> dict:
             # PROCESSES are respawned like dead threads — they are
             # stateless, replay and weights live with the learner. Remote
             # actors (other machines) can only be observed, not respawned.
-            dead = service.dead_actors()
+            # Heartbeat liveness is only meaningful for STREAMING actors
+            # (async threads, spawned procs, remote fleets) — synchronous
+            # in-process actors ingest exactly once per cycle, so any slow
+            # cycle would trip the timeout spuriously.
+            track_liveness = (cfg.async_actors or cfg.actor_procs > 0
+                              or cfg.serve)
+            dead = service.dead_actors() if track_liveness else []
             last_metrics["dead_actors"] = len(dead)
             if dead:
                 print(f"WARNING: actors missing heartbeats: {dead}", flush=True)
