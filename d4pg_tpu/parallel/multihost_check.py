@@ -32,6 +32,10 @@ def main(argv=None) -> None:
     ap.add_argument("--process_id", type=int, required=True)
     ap.add_argument("--cpu", type=int, default=1,
                     help="force the CPU backend (simulation mode)")
+    ap.add_argument("--fused", type=int, default=0,
+                    help="exercise the sharded fused replay data plane "
+                         "(replay/sharded_per.py + learner/fused.py) "
+                         "instead of the host-batch sharded update")
     ns = ap.parse_args(argv)
 
     import jax
@@ -73,11 +77,38 @@ def main(argv=None) -> None:
         discount=(0.99 * (1.0 - done)).astype(np.float32),
     )
     losses = []
-    for _ in range(2):
-        batch = multihost.make_global_batch(local, mesh)
-        state, metrics = update(state, batch)
-        losses.append(float(jax.device_get(metrics["critic_loss"])))
-    assert int(jax.device_get(state.step)) == 2
+    if ns.fused:
+        # The fused sharded replay data plane across hosts: each host
+        # drains ITS rows into its local shards (collective insert), then
+        # both run the fused chunk — sample + update + priority write-back
+        # all inside one SPMD dispatch over the global mesh.
+        from d4pg_tpu.learner.fused import make_sharded_fused_chunk
+        from d4pg_tpu.replay.sharded_per import ShardedFusedReplay
+
+        buf = ShardedFusedReplay(256, obs_dim, act_dim, mesh, alpha=0.6)
+        for _ in range(4):
+            buf.add(local)
+            buf.drain()
+        fn = make_sharded_fused_chunk(config, mesh, k=2, batch_size=16,
+                                      alpha=0.6, donate=False)
+        trees = buf.trees
+        for _ in range(2):
+            state, trees, metrics = fn(state, trees, buf.storage, buf.size)
+            losses.append(float(jax.device_get(metrics["critic_loss"][-1])))
+        # per-host checkpoint payload survives a roundtrip into a fresh
+        # buffer (the multi-host sidecar resume path)
+        buf.trees = trees
+        snap = buf.state_dict()
+        buf2 = ShardedFusedReplay(256, obs_dim, act_dim, mesh, alpha=0.6)
+        buf2.load_state_dict(snap)
+        assert len(buf2) == len(buf) > 0
+        assert int(jax.device_get(state.step)) == 4
+    else:
+        for _ in range(2):
+            batch = multihost.make_global_batch(local, mesh)
+            state, metrics = update(state, batch)
+            losses.append(float(jax.device_get(metrics["critic_loss"])))
+        assert int(jax.device_get(state.step)) == 2
     assert all(np.isfinite(losses))
     print(
         f"multihost_check OK: process {ns.process_id}/{ns.num_processes}, "

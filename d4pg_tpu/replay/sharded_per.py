@@ -23,6 +23,17 @@ Host-side bookkeeping mirrors ``fused_buffer.FusedDeviceReplay``:
 ``add`` stages rows (bounded), ``drain`` flushes at chunk boundaries on
 the learner thread (single owner of the donated device handles),
 splitting rows round-robin so shard sizes stay balanced.
+
+MULTI-HOST: the same buffer runs over a global (multi-process) mesh —
+the production pod shape the reference approximates with one host's
+shared memory (``main.py:371-405``). Each host owns the data-axis
+shards of its LOCAL devices (the Ape-X layout: rows never cross hosts;
+only gradients and the one ``pmin`` scalar ride DCN). Host-side state
+(`_head`/`_size`/staging) covers only the owned shards; ``drain`` and
+``state_dict`` become collective calls — every host participates in the
+same SPMD insert with a globally-agreed pad width (one tiny allgather),
+and checkpoints hold each host's own shard-set (restored via the
+per-host sidecar scheme in ``train.py``).
 """
 
 from __future__ import annotations
@@ -33,6 +44,33 @@ import numpy as np
 
 from d4pg_tpu.replay.segment_tree import next_pow2
 from d4pg_tpu.replay.uniform import TransitionBatch, pack_rows, validate_rows
+
+
+def _owned_data_rows(mesh) -> tuple[list[int], bool]:
+    """Global data-axis indices whose devices ALL belong to this process,
+    and whether the mesh spans any remote devices at all. A data row split
+    across processes cannot host a replay shard (its ring rows would need
+    cross-host writes), so that layout is rejected outright."""
+    import jax
+
+    from d4pg_tpu.parallel.mesh import DATA_AXIS
+
+    axis = mesh.axis_names.index(DATA_AXIS)
+    rows = np.moveaxis(mesh.devices, axis, 0)
+    me = jax.process_index()
+    owned, remote = [], False
+    for i in range(rows.shape[0]):
+        procs = {d.process_index for d in rows[i].flat}
+        if procs == {me}:
+            owned.append(i)
+        else:
+            remote = True
+            if me in procs:
+                raise ValueError(
+                    f"data-axis row {i} is split across processes "
+                    f"{sorted(procs)}; replay shards must be host-local "
+                    "(put the model axis within a host)")
+    return owned, remote
 
 
 class ShardedPerTrees(NamedTuple):
@@ -78,59 +116,114 @@ class ShardedFusedReplay:
         self.prioritized = bool(prioritized)
         self.alpha = float(alpha)
 
+        # multi-host: this process's contiguous block of data-axis shards
+        # (contiguity is what make_array_from_process_local_data assembles
+        # from; global_mesh()'s process-contiguous device order guarantees
+        # it, and anything else is rejected here instead of mis-assembling)
+        self._owned, self._multiproc = _owned_data_rows(mesh)
+        self.n_local = len(self._owned)
+        if self._multiproc:
+            if not self.n_local:
+                raise ValueError(
+                    "this process owns no data-axis shard of the replay "
+                    "mesh; every participating host needs local devices "
+                    "on the data axis")
+            if self._owned != list(range(self._owned[0],
+                                         self._owned[0] + self.n_local)):
+                raise ValueError(
+                    f"this process's data-axis shards {self._owned} are "
+                    "not contiguous; build the mesh with global_mesh() "
+                    "(process-contiguous device order)")
+        self.local_start = self._owned[0] if self._owned else 0
+
         shard = NamedSharding(mesh, P(DATA_AXIS))
         n, c = self.n_shards, self.cap_shard
-        self.storage = jax.device_put(TransitionBatch(
-            obs=jnp.zeros((n, c, *obs_shape), obs_dtype),
-            action=jnp.zeros((n, c, act_dim), jnp.float32),
-            reward=jnp.zeros((n, c), jnp.float32),
-            next_obs=jnp.zeros((n, c, *obs_shape), obs_dtype),
-            done=jnp.zeros((n, c), jnp.float32),
-            discount=jnp.zeros((n, c), jnp.float32),
-        ), shard)
-        self.trees = (
-            jax.device_put(ShardedPerTrees(
+
+        def _zero_storage():
+            return TransitionBatch(
+                obs=jnp.zeros((n, c, *obs_shape), obs_dtype),
+                action=jnp.zeros((n, c, act_dim), jnp.float32),
+                reward=jnp.zeros((n, c), jnp.float32),
+                next_obs=jnp.zeros((n, c, *obs_shape), obs_dtype),
+                done=jnp.zeros((n, c), jnp.float32),
+                discount=jnp.zeros((n, c), jnp.float32),
+            )
+
+        def _zero_trees():
+            return ShardedPerTrees(
                 sum_tree=jnp.zeros((n, 2 * c), jnp.float32),
                 min_tree=jnp.full((n, 2 * c), jnp.inf, jnp.float32),
                 max_priority=jnp.ones((n,), jnp.float32),
-            ), shard)
-            if prioritized else None
-        )
-        # per-shard ring cursors / live sizes (host ints; device twin of
-        # sizes is passed to the chunk as a [n_shards] array)
-        self._head = np.zeros(n, np.int64)
-        self._size = np.zeros(n, np.int64)
-        # round-robin cursor: which shard receives the next staged row
+            )
+
+        if self._multiproc:
+            # host-local device_put cannot address other hosts' devices;
+            # construct inside jit with sharded outputs (SPMD — every
+            # process traces the same zeros)
+            self.storage = jax.jit(_zero_storage, out_shardings=shard)()
+            self.trees = (jax.jit(_zero_trees, out_shardings=shard)()
+                          if prioritized else None)
+        else:
+            self.storage = jax.device_put(_zero_storage(), shard)
+            self.trees = (jax.device_put(_zero_trees(), shard)
+                          if prioritized else None)
+        # ring cursors / live sizes for the OWNED shards (host ints; the
+        # device twin of sizes is the chunk's [n_shards] ``size`` operand)
+        self._head = np.zeros(self.n_local, np.int64)
+        self._size = np.zeros(self.n_local, np.int64)
+        self._size_global = None  # cached global [n_shards] device array
+        # round-robin cursor: which LOCAL shard receives the next staged row
         self._rr = 0
         self._staged: list[TransitionBatch] = []
         self._staged_rows = 0
         self._insert_fn = None
 
+    @property
+    def local_capacity(self) -> int:
+        """Rows this host's shard-set can hold (== capacity single-host)."""
+        return self.cap_shard * self.n_local
+
     # -- ingest side (drain thread, under the service's buffer lock) -------
     def add(self, batch: TransitionBatch) -> None:
-        """Stage host rows; bounded at ~capacity like the single-device
-        fused buffer (oldest staged dropped — the next drain would
-        overwrite them anyway)."""
+        """Stage host rows; bounded at ~local capacity like the
+        single-device fused buffer (oldest staged dropped — the next drain
+        would overwrite them anyway)."""
         nrows = batch.obs.shape[0]
         if nrows == 0:
             return
-        if nrows > self.capacity:
+        if nrows > self.local_capacity:
             raise ValueError(
-                f"batch of {nrows} exceeds capacity {self.capacity}")
+                f"batch of {nrows} exceeds capacity {self.local_capacity}")
         self._staged.append(
             TransitionBatch(*[np.asarray(v) for v in batch]))
         self._staged_rows += nrows
         while (self._staged_rows - self._staged[0].obs.shape[0]
-               >= self.capacity):
+               >= self.local_capacity):
             self._staged_rows -= self._staged.pop(0).obs.shape[0]
 
     def __len__(self) -> int:
-        return int(min(self._size.sum() + self._staged_rows, self.capacity))
+        """THIS host's row count (live + staged) — the per-host warmup
+        gate; the global count is the sum over hosts."""
+        return int(min(self._size.sum() + self._staged_rows,
+                       self.local_capacity))
 
     @property
     def size(self):
-        """Per-shard live sizes [n_shards] (the chunk's ``size`` operand)."""
-        return self._size.astype(np.int32)
+        """Per-shard live sizes [n_shards] (the chunk's ``size`` operand).
+        Multi-host: a globally-sharded device array assembled from each
+        host's local sizes (cached until the next drain/restore)."""
+        if not self._multiproc:
+            return self._size.astype(np.int32)
+        if self._size_global is None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from d4pg_tpu.parallel.mesh import DATA_AXIS
+
+            self._size_global = jax.make_array_from_process_local_data(
+                NamedSharding(self.mesh, P(DATA_AXIS)),
+                self._size.astype(np.int32), (self.n_shards,))
+        return self._size_global
 
     # -- learner side ------------------------------------------------------
     def _make_insert(self):
@@ -178,45 +271,72 @@ class ShardedFusedReplay:
         return jax.jit(fn2, donate_argnums=(0,))
 
     def drain(self) -> int:
-        """Flush staged rows round-robin across shards. Learner thread
-        only (single owner of the donated handles)."""
-        if not self._staged:
+        """Flush staged rows round-robin across this host's shards.
+        Learner thread only (single owner of the donated handles).
+
+        MULTI-HOST: a COLLECTIVE call — every host must reach it at the
+        same point (train.py's chunk boundaries are lockstep). One scalar
+        allgather agrees on the pad width so all hosts execute the same
+        SPMD insert; a host with nothing staged contributes all-pad rows.
+        """
+        if not self._staged and not self._multiproc:
             return 0
-        batch = (self._staged[0] if len(self._staged) == 1 else
-                 TransitionBatch(*[
-                     np.concatenate([np.asarray(b[f]) for b in self._staged])
-                     for f in range(len(self._staged[0]))]))
+        if self._staged:
+            batch = (self._staged[0] if len(self._staged) == 1 else
+                     TransitionBatch(*[
+                         np.concatenate(
+                             [np.asarray(b[f]) for b in self._staged])
+                         for f in range(len(self._staged[0]))]))
+            nrows = batch.obs.shape[0]
+        else:
+            batch, nrows = None, 0
         self._staged.clear()
         self._staged_rows = 0
-        nrows = batch.obs.shape[0]
-        if nrows > self.capacity:
-            # keep exactly the newest `capacity` rows: a larger backlog
+        if nrows > self.local_capacity:
+            # keep exactly the newest rows that fit: a larger backlog
             # would hand some shard more than cap_shard rows, i.e.
             # duplicate slots in one scatter (unspecified winner)
-            batch = TransitionBatch(*[v[-self.capacity:] for v in batch])
-            nrows = self.capacity
-        n, cap = self.n_shards, self.cap_shard
+            batch = TransitionBatch(
+                *[v[-self.local_capacity:] for v in batch])
+            nrows = self.local_capacity
+        n, cap = self.n_local, self.cap_shard
 
-        # round-robin shard assignment, then per-shard local slots
-        shard_of = (self._rr + np.arange(nrows)) % n
-        self._rr = int((self._rr + nrows) % n)
-        m = next_pow2(int(np.ceil(nrows / n)))
+        # pad width m: power of two for the jit cache; multi-host takes
+        # the max over hosts so every process runs the same program
+        m = next_pow2(int(np.ceil(nrows / n))) if nrows else 0
+        if self._multiproc:
+            from jax.experimental import multihost_utils
+
+            m = int(np.max(multihost_utils.process_allgather(
+                np.int64(m))))
+        if m == 0:
+            return 0
+
+        # round-robin shard assignment, then per-shard local slots; with
+        # nothing staged locally (multi-host, a peer had rows) the arrays
+        # stay all-pad — shapes/dtypes come from the ring itself
         local_idx = np.full((n, m), cap, np.int32)  # cap -> dropped pad
         rows = TransitionBatch(*[
-            np.zeros((n, m, *np.asarray(v).shape[1:]), np.asarray(v).dtype)
-            for v in batch
+            np.zeros((n, m, *arr.shape[2:]), arr.dtype)
+            for arr in self.storage
         ])
-        for s in range(n):
-            take = np.flatnonzero(shard_of == s)
-            cnt = len(take)
-            if cnt == 0:
-                continue
-            local_idx[s, :cnt] = (self._head[s] + np.arange(cnt)) % cap
-            for f in range(len(rows)):
-                rows[f][s, :cnt] = np.asarray(batch[f])[take]
-            self._head[s] = int((self._head[s] + cnt) % cap)
-            self._size[s] = int(min(self._size[s] + cnt, cap))
+        if nrows:
+            shard_of = (self._rr + np.arange(nrows)) % n
+            self._rr = int((self._rr + nrows) % n)
+            for s in range(n):
+                take = np.flatnonzero(shard_of == s)
+                cnt = len(take)
+                if cnt == 0:
+                    continue
+                local_idx[s, :cnt] = (self._head[s] + np.arange(cnt)) % cap
+                for f in range(len(rows)):
+                    rows[f][s, :cnt] = np.asarray(batch[f])[take]
+                self._head[s] = int((self._head[s] + cnt) % cap)
+                self._size[s] = int(min(self._size[s] + cnt, cap))
+            self._size_global = None
 
+        if self._multiproc:
+            local_idx, rows = self._assemble_global(local_idx, rows)
         if self._insert_fn is None:
             self._insert_fn = self._make_insert()
         if self.trees is not None:
@@ -226,29 +346,64 @@ class ShardedFusedReplay:
             self.storage = self._insert_fn(self.storage, local_idx, rows)
         return nrows
 
-    # -- checkpointing -----------------------------------------------------
-    def state_dict(self) -> dict:
+    def _assemble_global(self, local_idx, rows):
+        """Lift this host's [n_local, m, ...] staging arrays to global
+        [n_shards, m, ...] arrays sharded over the data axis (each process
+        contributes its own block; nothing crosses DCN)."""
         import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from d4pg_tpu.parallel.mesh import DATA_AXIS
+
+        shard = NamedSharding(self.mesh, P(DATA_AXIS))
+
+        def to_global(x):
+            x = np.asarray(x)
+            return jax.make_array_from_process_local_data(
+                shard, x, (self.n_shards, *x.shape[1:]))
+
+        return to_global(local_idx), TransitionBatch(
+            *[to_global(v) for v in rows])
+
+    # -- checkpointing -----------------------------------------------------
+    def _local_block(self, arr, axis: int = 0):
+        """This host's contiguous block of a data-axis-sharded array as
+        host numpy (dedups model-axis replicas by shard start index)."""
+        seen = {}
+        for s in arr.addressable_shards:
+            start = s.index[axis].start or 0
+            if start not in seen:
+                seen[start] = np.asarray(s.data)
+        return np.concatenate([seen[k] for k in sorted(seen)], axis=axis)
+
+    def state_dict(self) -> dict:
+        """Checkpoint payload for THIS host's shard-set. Single-host that
+        is the whole buffer; multi-host each host snapshots only its own
+        shards (the per-host sidecar scheme in ``train.py``) — collective
+        (the leading drain), so all hosts must checkpoint in lockstep."""
         self.drain()
-        host = jax.device_get(self.storage)
-        d = pack_rows(
-            TransitionBatch(*[np.asarray(v) for v in host]),
-            0, 0, self.capacity)
+        host = TransitionBatch(
+            *[self._local_block(v) for v in self.storage])
+        d = pack_rows(host, 0, 0, self.capacity)
         d["sharded"] = {
             "head": self._head.copy(),
             "size": self._size.copy(),
             "rr": self._rr,
             "n_shards": self.n_shards,
+            "n_local": self.n_local,
+            "local_start": self.local_start,
         }
         if self.trees is not None:
-            t = jax.device_get(self.trees)
-            d["sharded"]["leaf_priorities"] = np.asarray(
-                t.sum_tree[:, self.cap_shard:])
-            d["sharded"]["max_priority"] = np.asarray(t.max_priority)
+            d["sharded"]["leaf_priorities"] = self._local_block(
+                self.trees.sum_tree)[:, self.cap_shard:]
+            d["sharded"]["max_priority"] = self._local_block(
+                self.trees.max_priority)
         return d
 
     def load_state_dict(self, d: dict) -> None:
+        """Restore this host's shard-set. Multi-host: collective — every
+        host loads ITS OWN snapshot at the same point (train.py agrees on
+        snapshot availability across hosts before any host calls this)."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -265,17 +420,33 @@ class ShardedFusedReplay:
             raise ValueError(
                 "sharded replay checkpoint requires the same data-parallel "
                 f"degree (got {s['n_shards']}, have {self.n_shards})")
+        n_local = int(s.get("n_local", s["n_shards"]))
+        start = int(s.get("local_start", 0))
+        if n_local != self.n_local or start != self.local_start:
+            raise ValueError(
+                f"replay snapshot covers shards [{start}, {start + n_local})"
+                f" but this host owns [{self.local_start}, "
+                f"{self.local_start + self.n_local}); resume with the same "
+                "host topology (process count and devices per host)")
         validate_rows({k: v for k, v in d.items() if k != "sharded"},
                       self.capacity)
         shard = NamedSharding(self.mesh, P(DATA_AXIS))
-        self.storage = jax.device_put(TransitionBatch(
-            *[jnp.asarray(d["rows"][f]) for f in TransitionBatch._fields]),
-            shard)
+        n, c = self.n_local, self.cap_shard
+
+        def to_global(x):
+            x = np.asarray(x)
+            if not self._multiproc:
+                return jax.device_put(jnp.asarray(x), shard)
+            return jax.make_array_from_process_local_data(
+                shard, x, (self.n_shards, *x.shape[1:]))
+
+        self.storage = TransitionBatch(
+            *[to_global(d["rows"][f]) for f in TransitionBatch._fields])
         self._head = np.asarray(s["head"]).astype(np.int64).copy()
         self._size = np.asarray(s["size"]).astype(np.int64).copy()
+        self._size_global = None
         self._rr = int(s["rr"])
         if self.trees is not None:
-            n, c = self.n_shards, self.cap_shard
             leaves = np.asarray(s["leaf_priorities"], np.float32)
             sum_tree = np.zeros((n, 2 * c), np.float32)
             min_tree = np.full((n, 2 * c), np.inf, np.float32)
@@ -293,8 +464,9 @@ class ShardedFusedReplay:
                 kids_m = min_tree[:, 2 * lo:4 * lo].reshape(n, -1, 2)
                 sum_tree[:, lo:2 * lo] = kids_s.sum(-1)
                 min_tree[:, lo:2 * lo] = kids_m.min(-1)
-            self.trees = jax.device_put(ShardedPerTrees(
-                sum_tree=jnp.asarray(sum_tree),
-                min_tree=jnp.asarray(min_tree),
-                max_priority=jnp.asarray(s["max_priority"], jnp.float32),
-            ), shard)
+            self.trees = ShardedPerTrees(
+                sum_tree=to_global(sum_tree),
+                min_tree=to_global(min_tree),
+                max_priority=to_global(
+                    np.asarray(s["max_priority"], np.float32)),
+            )

@@ -56,6 +56,7 @@ from d4pg_tpu.parallel import (
     shard_batch,
     stacked_sharding,
 )
+from d4pg_tpu.parallel.mesh import DATA_AXIS
 from d4pg_tpu.replay import LinearSchedule, PrioritizedReplayBuffer, ReplayBuffer
 from d4pg_tpu.replay.uniform import TransitionBatch
 
@@ -227,34 +228,39 @@ def train(cfg: ExperimentConfig) -> dict:
         obs_elems = int(np.prod(obs_dim)) if not np.isscalar(obs_dim) else obs_dim
         ring_bytes = cfg.memory_size * (
             2 * obs_elems * np.dtype(obs_dtype).itemsize + (act_dim + 3) * 4)
+        # the ring shards over the mesh's data axis, so the HBM budget is
+        # per-shard, not whole-ring
+        n_ring_shards = int(mesh.shape[DATA_AXIS]) if mesh is not None else 1
         storage = (
             "device"
             if jax.default_backend() != "cpu"
-            and not multi_host and ring_bytes < 8e9
-            # a sharded (mesh) learner can only use device storage through
-            # the fused path — 'auto' must resolve to host, not raise,
-            # when that path is disabled
-            and (cfg.fused_replay != "off" or cfg.data_parallel == 1)
+            and ring_bytes / n_ring_shards < 8e9
+            # a sharded (mesh) learner — and ANY multi-host learner — can
+            # only use device storage through the fused path; 'auto' must
+            # resolve to host, not raise, when that path is disabled
+            and (cfg.fused_replay != "off"
+                 or (cfg.data_parallel == 1 and not multi_host))
             else "host"
         )
-    elif storage == "device" and multi_host:
+    elif storage == "device" and multi_host and cfg.fused_replay == "off":
         raise ValueError(
-            "--replay_storage device is not supported on the multi-host "
-            "runtime (per-host replay shards stay in host RAM); use 'host' "
-            "or 'auto'")
+            "--replay_storage device on the multi-host runtime requires "
+            "the fused replay path (--fused_replay auto/on); with it "
+            "disabled, per-host replay shards stay in host RAM — use "
+            "'host' or 'auto'")
     # Fully-fused replay+learn path (learner/fused.py): the PER trees join
     # the ring in HBM and the whole per-step replay protocol runs inside
     # the scanned dispatch — zero per-chunk host round trips, zero priority
     # staleness (at K=1 this IS the reference's exact per-step write-back,
     # ddpg.py:252-255, executed on device). With a mesh the ring and trees
-    # shard over the data axis (each device samples its own B/N rows).
-    fused = (cfg.fused_replay != "off" and storage == "device"
-             and not multi_host)
+    # shard over the data axis (each device samples its own B/N rows);
+    # multi-host, each host owns its local devices' shards and drains its
+    # own actors' rows into them (replay/sharded_per.py).
+    fused = cfg.fused_replay != "off" and storage == "device"
     if cfg.fused_replay == "on" and not fused:
         raise ValueError(
-            "--fused_replay on requires device replay storage on a "
-            "single-host learner (storage resolved to "
-            f"{storage!r}, multi_host={multi_host})")
+            "--fused_replay on requires device replay storage "
+            f"(storage resolved to {storage!r})")
     if storage == "device" and not fused:
         # the non-fused device ring lives on ONE device; a sharded learner
         # would re-pay the cross-device copy every dispatch
@@ -265,11 +271,12 @@ def train(cfg: ExperimentConfig) -> dict:
     if fused and mesh is not None:
         from d4pg_tpu.replay.sharded_per import ShardedFusedReplay
 
-        if cfg.batch_size % cfg.data_parallel:
+        n_data = int(mesh.shape[DATA_AXIS])
+        if cfg.batch_size % n_data:
             # fail at startup, not after a whole warmup of rollouts
             raise ValueError(
-                f"--bsize {cfg.batch_size} must divide by --data_parallel "
-                f"{cfg.data_parallel} for the sharded fused replay path")
+                f"--bsize {cfg.batch_size} must divide by the mesh's data "
+                f"axis ({n_data}) for the sharded fused replay path")
         buffer = ShardedFusedReplay(cfg.memory_size, obs_dim, act_dim, mesh,
                                     alpha=cfg.per_alpha,
                                     prioritized=cfg.prioritized_replay,
@@ -408,7 +415,20 @@ def train(cfg: ExperimentConfig) -> dict:
             snap = (extra.pop("replay", None) if is_main
                     else _load_host_replay(run_dir, jax.process_index(),
                                            restored_step))
-            if snap:
+            if fused:
+                # the sharded fused restore path is COLLECTIVE (its drain
+                # allgathers); a host loading while a peer with a missing/
+                # stale sidecar skips would deadlock — agree first, and on
+                # disagreement all hosts start with empty replay
+                all_have = int(np.min(multihost_utils.process_allgather(
+                    np.int32(1 if snap else 0))))
+                if all_have:
+                    service.load_replay_state(snap)
+                elif snap:
+                    print(f"[p{jax.process_index()}] a peer host is missing "
+                          "its replay sidecar; all hosts restart with empty "
+                          "replay", flush=True)
+            elif snap:
                 service.load_replay_state(snap)
             print(f"[p{jax.process_index()}] resumed from step "
                   f"{int(jax.device_get(state.step))} ({service.env_steps} "
@@ -462,23 +482,28 @@ def train(cfg: ExperimentConfig) -> dict:
         noise=cfg.noise, random_eps=cfg.random_eps, ou_theta=cfg.ou_theta,
         ou_sigma=cfg.ou_sigma, ou_mu=cfg.ou_mu, device=cfg.actor_device,
     )
+    # Actor/env seeds get a per-PROCESS offset: the learner's init seed must
+    # be identical on every host (replicated params), but each host's actors
+    # must explore decorrelated — without this, all hosts collect the same
+    # trajectories and the multi-host fleet adds no data diversity.
+    aseed = cfg.seed + 100_003 * jax.process_index()
     actors = []
     for w in range(cfg.n_workers):
         if cfg.her:
             actor = GoalActorWorker(
                 f"actor-{w}", config, actor_cfg,
-                make_env_fn(cfg, seed=cfg.seed + w)(), service, weights,
-                her_ratio=cfg.her_ratio, rng_seed=cfg.seed + w, seed=cfg.seed + w,
+                make_env_fn(cfg, seed=aseed + w)(), service, weights,
+                her_ratio=cfg.her_ratio, rng_seed=aseed + w, seed=aseed + w,
                 obs_norm=obs_norm,
             )
         else:
             pool = EnvPool(
-                [make_env_fn(cfg, seed=cfg.seed + w * cfg.num_envs + i)
+                [make_env_fn(cfg, seed=aseed + w * cfg.num_envs + i)
                  for i in range(cfg.num_envs)],
-                seed=cfg.seed + w,
+                seed=aseed + w,
             )
             actor = ActorWorker(f"actor-{w}", config, actor_cfg, pool, service,
-                                weights, seed=cfg.seed + w, obs_dtype=obs_dtype,
+                                weights, seed=aseed + w, obs_dtype=obs_dtype,
                                 obs_norm=obs_norm)
         actors.append(actor)
     # Process 0 owns eval (multi-host: other hosts' rollouts would only be
@@ -511,6 +536,10 @@ def train(cfg: ExperimentConfig) -> dict:
     # --- optional network serving for remote actors (actor_main.py) ------
     receiver = weight_server = None
     actor_processes: list = []
+    # per-slot respawn bookkeeping (supervisor below): generation varies
+    # the child's seed; consecutive failures cap the crash-loop
+    actor_proc_gen: list[int] = [0] * max(0, cfg.actor_procs)
+    actor_proc_fails: list[int] = [0] * max(0, cfg.actor_procs)
     if cfg.serve or cfg.actor_procs > 0:
         from d4pg_tpu.distributed.transport import TransitionReceiver
         from d4pg_tpu.distributed.weight_server import WeightServer
@@ -541,11 +570,14 @@ def train(cfg: ExperimentConfig) -> dict:
             "127.0.0.1" if cfg.serve_host in ("0.0.0.0", "127.0.0.1")
             else cfg.serve_host
         )
-        def spawn_actor_proc(i: int):
+        def spawn_actor_proc(i: int, gen: int = 0):
             # stateless by design (replay + weights live with the learner),
-            # so the supervisor can respawn with the same config/identity
+            # so the supervisor can respawn with the same config/identity.
+            # The seed varies per respawn GENERATION: a respawned child
+            # reusing its seed would re-stream duplicate early
+            # trajectories into replay (ADVICE r3).
             proc_cfg = dataclasses.replace(
-                cfg, seed=cfg.seed + 1000 * (i + 1), actor_procs=0,
+                cfg, seed=aseed + 1000 * (i + 1) + 101 * gen, actor_procs=0,
                 serve=False)
             p = ctx.Process(
                 target=run_local_actor_process,
@@ -651,10 +683,18 @@ def train(cfg: ExperimentConfig) -> dict:
                 # bounded staleness <= K without stalling the dispatch
                 # pipeline: an on-device param copy (async dispatch; the
                 # next chunk's donation would otherwise invalidate the
-                # buffers readers hold) instead of a blocking D2H pull
-                weights.publish(copy_params(state.actor_params),
-                                step=lstep, to_host=False,
-                                norm_stats=_norm_snapshot())
+                # buffers readers hold) instead of a blocking D2H pull.
+                # Multi-host actors act on host arrays (a replicated
+                # global array would pin the actor's jit to the global
+                # mesh), so there the pull is D2H.
+                if multi_host:
+                    weights.publish(jax.device_get(state.actor_params),
+                                    step=lstep,
+                                    norm_stats=_norm_snapshot())
+                else:
+                    weights.publish(copy_params(state.actor_params),
+                                    step=lstep, to_host=False,
+                                    norm_stats=_norm_snapshot())
         if metrics is None:
             return None
         return {name: metrics[name][-1]
@@ -899,10 +939,28 @@ def train(cfg: ExperimentConfig) -> dict:
             if dead:
                 print(f"WARNING: actors missing heartbeats: {dead}", flush=True)
             for i, p in enumerate(actor_processes):
-                if not p.is_alive():
-                    print(f"supervisor: restarting actor process {i} "
-                          f"(exitcode {p.exitcode})", flush=True)
-                    actor_processes[i] = spawn_actor_proc(i)
+                if p is None:  # slot retired after repeated crash-looping
+                    continue
+                if p.is_alive():
+                    actor_proc_fails[i] = 0
+                    continue
+                # once-per-cycle cadence already rate-limits respawns; the
+                # consecutive-failure cap stops a child that cannot start
+                # at all (bad GL/env config) from crash-looping forever
+                # (ADVICE r3)
+                actor_proc_fails[i] += 1
+                if actor_proc_fails[i] > 5:
+                    print(f"supervisor: actor process {i} died "
+                          f"{actor_proc_fails[i]} consecutive cycles "
+                          f"(exitcode {p.exitcode}); giving up on this "
+                          "slot", flush=True)
+                    actor_processes[i] = None
+                    continue
+                actor_proc_gen[i] += 1
+                print(f"supervisor: restarting actor process {i} "
+                      f"(exitcode {p.exitcode}, respawn "
+                      f"#{actor_proc_gen[i]})", flush=True)
+                actor_processes[i] = spawn_actor_proc(i, actor_proc_gen[i])
             if cfg.async_actors:
                 supervise_actors()
             bus.log(lstep, last_metrics)
@@ -950,9 +1008,11 @@ def train(cfg: ExperimentConfig) -> dict:
         ckpt.wait()
     bus.close()
     for p in actor_processes:
-        p.terminate()
+        if p is not None:
+            p.terminate()
     for p in actor_processes:
-        p.join(timeout=5.0)
+        if p is not None:
+            p.join(timeout=5.0)
     if receiver is not None:
         receiver.close()
     if weight_server is not None:

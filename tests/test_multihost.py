@@ -96,6 +96,95 @@ def test_two_processes_form_one_mesh():
     assert oks[0].split("losses")[1] == oks[1].split("losses")[1]
 
 
+def test_two_processes_fused_replay_plane():
+    """VERDICT r3 #1: the fused sharded replay data plane on the
+    multi-host runtime — each host drains its rows into its own shard-set
+    (collective insert), the fused chunk runs SPMD over the global mesh,
+    and the per-host checkpoint payload roundtrips. Replica losses must
+    agree bit-for-bit across processes."""
+    port = _free_port()
+    env = _mh_env()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "d4pg_tpu.parallel.multihost_check",
+             "--coordinator", f"127.0.0.1:{port}",
+             "--num_processes", "2", "--process_id", str(i), "--fused", "1"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+        assert p.returncode == 0, out[-2000:]
+    oks = [line for out in outs for line in out.splitlines()
+           if line.startswith("multihost_check OK")]
+    assert len(oks) == 2
+    assert oks[0].split("losses")[1] == oks[1].split("losses")[1]
+
+
+def test_two_process_fused_full_train_and_resume(tmp_path):
+    """The real train() CLI with --fused_replay on across two processes
+    (VERDICT r3 #1's 'production configuration'): device-sharded ring +
+    trees over the global mesh, collective drains at chunk boundaries,
+    per-cycle checkpointing with per-host replay sidecars, then a resume
+    where BOTH hosts restore their own shard-set."""
+    env = _mh_env()
+    base = [
+        "--env", "point", "--max_steps", "20", "--num_envs", "2",
+        "--warmup", "100", "--n_eps", "1", "--n_cycles", "2",
+        "--episodes_per_cycle", "1", "--train_steps_per_cycle", "18",
+        "--updates_per_dispatch", "8", "--eval_trials", "1",
+        "--bsize", "16", "--rmsize", "2000", "--n_atoms", "11",
+        "--v_min", "-5.0", "--v_max", "0.0",
+        "--replay_storage", "device", "--fused_replay", "on",
+        "--checkpoint_replay", "1", "--checkpoint_replay_every", "1",
+        "--log_dir", str(tmp_path), "--num_processes", "2",
+    ]
+
+    def launch(extra_args):
+        port = _free_port()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m", "d4pg_tpu.train", *base, *extra_args,
+                 "--coordinator", f"127.0.0.1:{port}",
+                 "--process_id", str(i)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True,
+            )
+            for i in range(2)
+        ]
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+            assert p.returncode == 0, out[-3000:]
+        return outs
+
+    outs = launch([])
+    assert all("final:" in out for out in outs)
+    # the two replicas ended on the SAME loss (losses are printed in the
+    # final dict; replica divergence would show up here)
+    finals = [out.rsplit("final:", 1)[1].split("critic_loss': ")[1]
+                 .split(",")[0] for out in outs]
+    assert finals[0] == finals[1], finals
+    # both hosts wrote their replay shard (p0 via Orbax extra, p1 sidecar)
+    run_dirs = [d for d in os.listdir(tmp_path) if d.startswith("exp_")]
+    assert len(run_dirs) == 1
+    assert os.path.exists(os.path.join(tmp_path, run_dirs[0], "replay_p1.pkl"))
+
+    outs = launch(["--resume", "1"])
+    import re
+
+    for i, out in enumerate(outs):
+        assert f"[p{i}] resumed from step 36" in out, out[-3000:]
+    rows = [int(re.search(r"(\d+) replay rows", out).group(1))
+            for out in outs]
+    assert all(r > 0 for r in rows), rows
+
+
 def test_two_process_resume_with_normalize(tmp_path):
     """VERDICT r2 #6: the multi-host runtime must support --resume and
     --normalize_obs. Run 1 trains with synced observation normalization
