@@ -45,13 +45,15 @@ def _unflatten(flat: dict[str, np.ndarray]):
 
 from d4pg_tpu.distributed.transport import (
     MAX_PAYLOAD,
+    ConnRegistry,
+    ProtocolError,
+    ReconnectingClient,
     _recv_exact,
-    client_handshake,
     server_handshake,
 )
 
 
-class WeightServer:
+class WeightServer(ConnRegistry):
     """Serves a WeightStore's latest params to remote pullers.
 
     Binds loopback by default (pass the DCN interface for cross-host
@@ -60,6 +62,7 @@ class WeightServer:
 
     def __init__(self, store: WeightStore, host: str = "127.0.0.1",
                  port: int = 0, secret: str | None = None):
+        super().__init__()
         self._store = store
         self._secret = secret
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -80,6 +83,7 @@ class WeightServer:
                 continue
             except OSError:
                 return
+            self._register_conn(conn)
             threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
 
     def _serve(self, conn: socket.socket) -> None:
@@ -123,6 +127,8 @@ class WeightServer:
                     conn.sendall(_RESP.pack(_MAGIC, len(payload)) + payload)
         except OSError:
             return  # peer died mid-frame (actor terminated); drop it
+        finally:
+            self._unregister_conn(conn)
 
     def close(self) -> None:
         self._stop.set()
@@ -130,35 +136,84 @@ class WeightServer:
             self._server.close()
         except OSError:
             pass
+        self._shutdown_conns()
 
 
-class WeightClient:
+class WeightClient(ReconnectingClient):
     """Actor-side puller mirroring the WeightStore reader interface, so a
-    remote actor constructs its WeightStore-shaped view from the wire."""
+    remote actor constructs its WeightStore-shaped view from the wire.
+
+    Degrades to STALE weights while the learner is down (VERDICT r3 #5):
+    a failed pull drops the socket and returns None — "nothing newer" —
+    so the actor keeps acting on its last weights instead of crashing;
+    each subsequent pull attempts one quick reconnect. Only after
+    ``down_timeout`` seconds of continuous unreachability does it raise
+    (a permanently-gone learner should stop the fleet, not spin it on
+    stale policies forever). Deterministic wire-format violations
+    (``ProtocolError``: bad magic, oversized payload) are NOT absorbed —
+    they surface at the first frame, since reconnecting cannot heal a
+    version/config fault. The initial connect fails fast, surfacing
+    config errors at startup."""
 
     def __init__(self, host: str, port: int, connect_timeout: float = 10.0,
-                 secret: str | None = None):
-        self._sock = socket.create_connection((host, port), timeout=connect_timeout)
-        client_handshake(self._sock, secret)
-        self._sock.settimeout(None)
-        self._lock = threading.Lock()
+                 secret: str | None = None, down_timeout: float = 300.0,
+                 reconnect_interval: float = 10.0):
+        self._down_timeout = down_timeout
+        self._down_since: float | None = None
+        self._ever_pulled = False
+        # reconnects are rate-limited: the pull runs ON the acting thread,
+        # and against a black-holing peer (no RST — e.g. a rebooting VM)
+        # each attempt blocks for up to connect_timeout. At most one
+        # blocked attempt per interval; pulls in between return None
+        # immediately so rollouts continue on stale weights.
+        self._reconnect_interval = reconnect_interval
+        self._next_reconnect = 0.0
+        super().__init__(host, port, connect_timeout, secret)
         self.step = 0
         self.norm_stats: tuple | None = None  # (mean, std) when served
 
     def get_if_newer(self, have_version: int):
+        import time
+
         with self._lock:
-            self._sock.sendall(_REQ.pack(_MAGIC, int(have_version)))
-            head = _recv_exact(self._sock, _RESP.size)
-            if head is None:
-                raise ConnectionError("weight server closed the connection")
-            magic, length = _RESP.unpack(head)
-            if magic != _MAGIC or length > MAX_PAYLOAD:
-                raise ConnectionError("corrupt weight stream")
-            if length == 0:
-                return None
-            payload = _recv_exact(self._sock, length)
-            if payload is None:
-                raise ConnectionError("truncated weight payload")
+            self._check_open()
+            if (self._sock is None and self._ever_pulled
+                    and time.monotonic() < self._next_reconnect):
+                return None  # between rate-limited reconnect attempts
+            try:
+                if self._sock is None:
+                    self._next_reconnect = (time.monotonic()
+                                            + self._reconnect_interval)
+                    self._connect()
+                payload = self._pull(have_version)
+                # the server ANSWERED (even "nothing newer"): the secret
+                # and protocol are good, stale-degradation is armed
+                self._ever_pulled = True
+                self._down_since = None
+            except ProtocolError:
+                self._drop_sock()
+                raise
+            except (OSError, ConnectionError):
+                self._drop_sock()
+                self._check_open()
+                if not self._ever_pulled:
+                    # no pull has EVER succeeded — there are no stale
+                    # weights to act on, and a server that drops a fresh
+                    # connection before its first answer is a config/auth
+                    # fault (e.g. wrong --secret: the handshake rejection
+                    # looks like a close from here). Fail fast.
+                    raise
+                now = time.monotonic()
+                if self._down_since is None:
+                    self._down_since = now
+                if now - self._down_since > self._down_timeout:
+                    raise ConnectionError(
+                        f"weight server unreachable for "
+                        f"{self._down_timeout:.0f}s at "
+                        f"{self._addr[0]}:{self._addr[1]}")
+                return None  # act on stale weights; retry next pull
+        if payload is None:
+            return None
         with np.load(io.BytesIO(payload)) as z:
             flat = {k: z[k] for k in z.files if not k.startswith("__")}
             version = int(z["__version__"])
@@ -169,8 +224,18 @@ class WeightClient:
                     self.norm_stats += (float(z["__norm_clip__"]),)
         return version, _unflatten(flat)
 
-    def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+    def _pull(self, have_version: int) -> bytes | None:
+        """One request/response on the live socket; raises on any break."""
+        self._sock.sendall(_REQ.pack(_MAGIC, int(have_version)))
+        head = _recv_exact(self._sock, _RESP.size)
+        if head is None:
+            raise ConnectionError("weight server closed the connection")
+        magic, length = _RESP.unpack(head)
+        if magic != _MAGIC or length > MAX_PAYLOAD:
+            raise ProtocolError("corrupt weight stream")
+        if length == 0:
+            return None
+        payload = _recv_exact(self._sock, length)
+        if payload is None:
+            raise ConnectionError("truncated weight payload")
+        return payload

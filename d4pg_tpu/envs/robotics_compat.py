@@ -90,27 +90,85 @@ def _tree_fingerprint(root: str) -> bytes:
     return b"\0".join(parts)
 
 
-def _prune_stale_mirrors(root_tag: str, keep: str) -> None:
+def _prune_stale_mirrors(root_tag: str, keep: str,
+                         min_age_s: float = 3600.0) -> None:
     """Remove this uid's mirrors of the SAME assets tree whose content tag
     is superseded — each source change (package upgrade) mints a new tag,
     and nothing else ever deletes the orphaned tree of patched XMLs +
     symlinks. Mirrors of other trees (different ``root_tag``) may be in
-    concurrent use by sibling processes and are never touched."""
+    concurrent use by sibling processes and are never touched.
+
+    In-use guard (ADVICE r3): a long-lived sibling process started BEFORE
+    an in-place package upgrade still holds the old-tag path in its
+    module-level ``_shadow_dirs`` cache and re-reads MJCF from it at every
+    env construction; deleting it under that process breaks those
+    constructions. Every process therefore holds a SHARED flock on its
+    mirror's ``.inuse`` file for its lifetime (:func:`_hold_mirror_lock`);
+    the pruner only removes a mirror whose lock it can take exclusively —
+    crashed holders release the lock automatically. The mtime age gate
+    stays as a backstop for mirrors created by versions that predate the
+    lock file."""
+    import fcntl
     import glob
     import shutil
+    import time
 
     pattern = os.path.join(
         tempfile.gettempdir(),
         f"d4pg-tpu-mjcf-compat-{os.getuid()}-{root_tag}-*",
     )
+    now = time.time()
     for path in glob.glob(pattern):
         if path == keep:
             continue
         try:
-            if os.lstat(path).st_uid == os.getuid():
-                shutil.rmtree(path, ignore_errors=True)
+            st = os.lstat(path)
+            if st.st_uid != os.getuid():
+                continue
+            # mtime of the mirror root moves on directory mutation only;
+            # young mirror == a sibling may still be mid-creation of it
+            if now - st.st_mtime < min_age_s:
+                continue
+            lock_path = os.path.join(path, _INUSE_NAME)
+            fd = None
+            try:
+                fd = os.open(lock_path, os.O_RDONLY)
+            except OSError:
+                pass  # no lock file (pre-lock-version mirror): age decides
+            if fd is not None:
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                except OSError:
+                    continue  # a live sibling holds it: in use, skip
+                finally:
+                    os.close(fd)
+            shutil.rmtree(path, ignore_errors=True)
         except OSError:
             pass
+
+
+_INUSE_NAME = ".inuse"
+# fds of held mirror locks, keyed by mirror root; intentionally kept open
+# for process lifetime so the pruner in sibling processes sees the mirror
+# as in use (released by the kernel on exit/crash)
+_mirror_lock_fds: dict = {}
+
+
+def _hold_mirror_lock(shadow_root: str) -> None:
+    """Take (and keep) a shared flock on the mirror's ``.inuse`` file so
+    concurrent pruners never delete a mirror this process may still read
+    MJCF from."""
+    if shadow_root in _mirror_lock_fds:
+        return
+    import fcntl
+
+    lock_path = os.path.join(shadow_root, _INUSE_NAME)
+    try:
+        fd = os.open(lock_path, os.O_CREAT | os.O_RDONLY, 0o600)
+        fcntl.flock(fd, fcntl.LOCK_SH)
+        _mirror_lock_fds[shadow_root] = fd
+    except OSError:
+        pass  # lock is best-effort; the age gate still applies
 
 
 def _shadow_dir(src_dir: str) -> str:
@@ -146,6 +204,11 @@ def _shadow_dir(src_dir: str) -> str:
         # someone else owns (or symlinked) the predictable path: fall back
         # to a private unshared mirror rather than trusting its contents
         shadow_root = tempfile.mkdtemp(prefix="d4pg-tpu-mjcf-compat-")
+    else:
+        # mark the shared mirror in use for this process's lifetime so
+        # sibling pruners (a later package upgrade mints a new tag) leave
+        # it alone while we may still re-read its MJCF
+        _hold_mirror_lock(shadow_root)
     for cur, dirs, files in os.walk(root):
         dst_cur = os.path.join(shadow_root, os.path.relpath(cur, root))
         os.makedirs(dst_cur, exist_ok=True)
