@@ -288,10 +288,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n_atoms", type=int, default=d.n_atoms)
     p.add_argument("--critic_family", choices=("categorical", "mog"),
                    default=d.critic_family)
-    p.add_argument("--projection", choices=("einsum", "pallas"),
+    p.add_argument("--projection",
+                   choices=("einsum", "pallas", "pallas_ce"),
                    default=d.projection,
                    help="categorical Bellman-projection impl: MXU einsum "
-                        "(default) or the fused Pallas kernel")
+                        "(default), the VMEM Pallas projection kernel, or "
+                        "pallas_ce (projection fused into the cross-"
+                        "entropy loss, forward + backward)")
     p.add_argument("--compute_dtype", choices=("float32", "bfloat16"),
                    default=d.compute_dtype)
     p.add_argument("--noise", choices=("gaussian", "ou"), default=d.noise)

@@ -38,6 +38,14 @@ def cross_entropy_per_sample(proj: Array, pred_probs: Array) -> Array:
     return -jnp.sum(proj * jnp.log(pred_probs + _LOG_EPS), axis=-1)
 
 
+def weighted_mean(td: Array, weights: Array | None = None) -> Array:
+    """THE loss reduction: mean of per-sample errors, PER IS-weighted when
+    ``weights`` is given. One definition shared by every critic-loss path
+    (einsum, fused Pallas, MoG) so the weighting convention cannot
+    diverge between them."""
+    return jnp.mean(td if weights is None else weights * td)
+
+
 def categorical_td_loss(
     proj: Array,
     pred_probs: Array,
@@ -51,8 +59,7 @@ def categorical_td_loss(
     uniform (reference behavior).
     """
     td = cross_entropy_per_sample(proj, pred_probs)
-    loss = jnp.mean(td if weights is None else weights * td)
-    return loss, td
+    return weighted_mean(td, weights), td
 
 
 def reference_td_error(proj: Array, pred_probs: Array) -> Array:

@@ -71,10 +71,13 @@ class D4PGConfig:
     compute_dtype: str = "float32"
     # Categorical-projection implementation: 'einsum' (dense MXU
     # interpolation-weight matmul, core/distribution.py — the default; XLA
-    # fuses it fully on-chip) or 'pallas' (the VMEM-resident fused kernel,
-    # ops/projection.py — measured ~1.2-1.7x slower at A=51 because
-    # pallas_call dispatch dominates at this op size; see README
-    # "Projection kernels"). Categorical family only; ignored by MoG.
+    # fuses it fully on-chip), 'pallas' (the VMEM-resident projection
+    # kernel, ops/projection.py — measured ~1.2-1.7x slower at A=51
+    # because pallas_call dispatch dominates at this op size), or
+    # 'pallas_ce' (projection FUSED into the cross-entropy reduction with
+    # a custom VJP, ops/projection_ce.py — removes the proj round trip in
+    # both passes; see README "Projection kernels"). Categorical family
+    # only; ignored by MoG.
     projection: str = "einsum"
 
     def __post_init__(self):
@@ -86,7 +89,7 @@ class D4PGConfig:
             raise ValueError(f"unknown critic_family {self.critic_family!r}")
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
-        if self.projection not in ("einsum", "pallas"):
+        if self.projection not in ("einsum", "pallas", "pallas_ce"):
             raise ValueError(f"unknown projection {self.projection!r}")
 
     @property

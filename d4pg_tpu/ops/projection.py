@@ -18,9 +18,11 @@ one lane tile). Runs under ``interpret=True`` on CPU for tests.
 Measured on a v5e chip (B=256/4096, A=51): bitwise-identical to the einsum
 path, but ~1.2-1.7x SLOWER — at this op size XLA's fused einsum already
 keeps everything on-chip and the pallas_call dispatch dominates. The
-einsum formulation therefore stays the default in the learner; this kernel
-is kept as the measured alternative and the template for future fusions
-(e.g. folding the projection into the loss reduction).
+einsum formulation therefore stays the default in the learner. The
+promised follow-up fusion EXISTS: ``ops/projection_ce.py`` folds the
+projection into the cross-entropy loss reduction (forward + custom VJP,
+``--projection pallas_ce``), removing the proj [B, A] HBM round trip this
+standalone kernel still pays.
 """
 
 from __future__ import annotations

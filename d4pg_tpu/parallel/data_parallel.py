@@ -65,11 +65,11 @@ def check_mesh_compatible(config: D4PGConfig) -> None:
     a sharded jit it would fail to compile or silently all-gather the
     batch onto every device. Mesh learners must use the einsum
     formulation (which shards trivially); fail loudly rather than either."""
-    if config.projection == "pallas":
+    if config.projection in ("pallas", "pallas_ce"):
         raise ValueError(
-            "--projection pallas is single-device only (pallas_call does "
-            "not partition under a sharded jit); use --projection einsum "
-            "with a device mesh"
+            f"--projection {config.projection} is single-device only "
+            "(pallas_call does not partition under a sharded jit); use "
+            "--projection einsum with a device mesh"
         )
 
 
