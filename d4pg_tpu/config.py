@@ -36,6 +36,11 @@ class ExperimentConfig:
     # settings make pixel training tractable on modest hosts
     pixel_size: int = 84
     encoder_width: int = 32
+    # frames stacked along the channel axis for pixel envs (FrameStack
+    # wrapper). 1 = raw single frames (a POMDP for dynamic tasks —
+    # velocities are invisible); 3 is the DrQ/D4PG-pixels convention and
+    # the right setting for dm_control pixel control.
+    frame_stack: int = 1
     reward_scale: float = 1.0
     # replay
     memory_size: int = 1_000_000  # --rmsize
@@ -262,6 +267,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="dm_control pixel render height/width")
     p.add_argument("--encoder_width", type=int, default=d.encoder_width,
                    help="conv-encoder channel width (4 layers)")
+    p.add_argument("--frame_stack", type=int, default=d.frame_stack,
+                   help="frames stacked channel-wise for pixel envs "
+                        "(1 = raw frames; 3 = DrQ/D4PG-pixels convention "
+                        "— single frames hide velocities)")
     p.add_argument("--rmsize", type=int, default=d.memory_size, dest="memory_size")
     p.add_argument("--bsize", type=int, default=d.batch_size, dest="batch_size")
     p.add_argument("--warmup", type=int, default=d.warmup)

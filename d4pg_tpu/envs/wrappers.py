@@ -61,3 +61,70 @@ def flatten_goal_obs(obs) -> np.ndarray:
     if isinstance(obs, dict):
         return np.concatenate([obs["observation"], obs["desired_goal"]], axis=-1)
     return np.asarray(obs)
+
+
+class FrameStack:
+    """Stack the last ``k`` pixel observations along the channel axis.
+
+    Pixel control from a SINGLE frame is a POMDP — velocities are
+    invisible, so tasks like cartpole-swingup (which way is the pole
+    moving?) are structurally unlearnable. Stacking k frames restores the
+    Markov property the state-vector path gets for free; every published
+    pixel-control baseline (DQN's 4-stack; DrQ/D4PG-pixels' 3-stack) does
+    this. The reference has no pixel path at all (``models.py:15`` is
+    state-only), so this wrapper has no reference analogue.
+
+    [H, W, C] -> [H, W, C*k], newest frame LAST (channels-concatenated);
+    ``reset`` fills the buffer with k copies of the first frame. uint8
+    in, uint8 out — the replay ring stores stacked rows directly.
+    """
+
+    def __init__(self, env, k: int):
+        from collections import deque
+
+        if k < 1:
+            raise ValueError(f"frame_stack must be >= 1, got {k}")
+        self.env = env
+        self._k = int(k)
+        self._frames: "deque" = deque(maxlen=self._k)
+        space = env.observation_space
+        if len(space.shape) != 3:
+            raise ValueError(
+                f"FrameStack wraps pixel [H, W, C] observations, got "
+                f"shape {space.shape}")
+        h, w, c = space.shape
+        import gymnasium.spaces
+
+        # duck-typed spaces (the fake test envs) may lack .dtype; the
+        # bound arrays always carry one (possibly wider than the actual
+        # frames — dims/dtype downstream come from a real reset obs in
+        # train.infer_dims, not from this advertisement). tile, not
+        # repeat: the data layout is whole frames concatenated
+        # [c0,c1,c2, c0,c1,c2, ...], so per-channel bounds must tile in
+        # the same order.
+        dtype = getattr(space, "dtype", None) or space.low.dtype
+        self.observation_space = gymnasium.spaces.Box(
+            low=np.tile(np.asarray(space.low), (1, 1, self._k)),
+            high=np.tile(np.asarray(space.high), (1, 1, self._k)),
+            shape=(h, w, c * self._k),
+            dtype=dtype,
+        )
+        self.action_space = env.action_space
+
+    def _stacked(self):
+        return np.concatenate(list(self._frames), axis=-1)
+
+    def reset(self, **kw):
+        obs, info = self.env.reset(**kw)
+        for _ in range(self._k):
+            self._frames.append(obs)
+        return self._stacked(), info
+
+    def step(self, action):
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        self._frames.append(obs)
+        return self._stacked(), reward, terminated, truncated, info
+
+    def close(self):
+        if hasattr(self.env, "close"):
+            self.env.close()

@@ -64,25 +64,45 @@ from d4pg_tpu.replay.uniform import TransitionBatch
 def make_env_fn(cfg: ExperimentConfig, seed: int):
     """Build one env instance; gymnasium by id, with fake-env fallbacks for
     ids 'point' and 'fake-goal' (tests/smoke, SURVEY.md §4)."""
+    if cfg.env in ("point", "fake-goal") and cfg.frame_stack > 1:
+        # fail loudly rather than silently training on unstacked frames —
+        # the exact POMDP failure the flag exists to fix
+        raise ValueError(
+            f"--frame_stack {cfg.frame_stack} requires a pixel env; "
+            f"{cfg.env!r} is state-observation")
     if cfg.env == "point":
         return lambda: PointMassEnv(horizon=cfg.max_steps, seed=seed)
     if cfg.env == "fake-goal":
         return lambda: FakeGoalEnv(horizon=cfg.max_steps, seed=seed)
+    def stack(make_pixel_env):
+        # FrameStack restores the Markov property for pixel control
+        # (single frames hide velocities); no-op at the default k=1
+        if cfg.frame_stack <= 1:
+            return make_pixel_env
+        from d4pg_tpu.envs.wrappers import FrameStack
+
+        return lambda: FrameStack(make_pixel_env(), cfg.frame_stack)
+
     if cfg.env == "pixel-point":
-        return lambda: PixelPointEnv(horizon=cfg.max_steps, seed=seed)
+        return stack(lambda: PixelPointEnv(horizon=cfg.max_steps, seed=seed))
     from d4pg_tpu.envs.dmc import DMControlEnv, parse_dmc_id
 
     dmc = parse_dmc_id(cfg.env)
     if dmc is not None:
         domain, task, pixels = dmc
-        return lambda: DMControlEnv(domain, task, pixels=pixels, seed=seed,
-                                    height=cfg.pixel_size,
-                                    width=cfg.pixel_size)
+        if not pixels and cfg.frame_stack > 1:
+            raise ValueError(
+                f"--frame_stack {cfg.frame_stack} requires a pixel env; "
+                f"{cfg.env!r} is state-observation")
+        mk = lambda: DMControlEnv(domain, task, pixels=pixels, seed=seed,
+                                  height=cfg.pixel_size,
+                                  width=cfg.pixel_size)
+        return stack(mk) if pixels else mk
     import gymnasium as gym
 
     def make():
         try:
-            return gym.make(cfg.env)
+            env = gym.make(cfg.env)
         except (gym.error.NameNotFound, gym.error.VersionNotFound):
             # Fetch/Adroit/Shadow-Hand live in gymnasium_robotics, which
             # registers its ids only once imported (BASELINE.md config #5).
@@ -94,7 +114,20 @@ def make_env_fn(cfg: ExperimentConfig, seed: int):
 
             install()
             gym.register_envs(gymnasium_robotics)
-            return gym.make(cfg.env)
+            env = gym.make(cfg.env)
+        if cfg.frame_stack > 1:
+            # stack 3-D (pixel) observations; anything else is a config
+            # error — silently dropping the flag would train on single
+            # frames, the exact POMDP failure it exists to fix
+            if len(env.observation_space.shape or ()) != 3:
+                raise ValueError(
+                    f"--frame_stack {cfg.frame_stack} requires pixel "
+                    f"[H, W, C] observations; {cfg.env!r} has shape "
+                    f"{env.observation_space.shape}")
+            from d4pg_tpu.envs.wrappers import FrameStack
+
+            return FrameStack(env, cfg.frame_stack)
+        return env
 
     return make
 
