@@ -165,11 +165,14 @@ def _host_replay_path(run_dir: str, process_index: int) -> str:
 
 def _save_host_replay(run_dir: str, process_index: int, step: int,
                       snap: dict) -> None:
-    """Sidecar replay-shard snapshot for multi-host hosts > 0 (process 0's
-    shard rides the Orbax ``extra`` payload). Stamped with the learner
-    step it was taken at so resume can refuse a shard from a different
-    training moment than the restored state. Write-then-rename so a crash
-    mid-save leaves the previous snapshot intact."""
+    """Sidecar replay snapshot — EVERY host's, process 0 included (round
+    4: replay used to ride the Orbax ``extra`` payload on process 0, but
+    that couples replay availability to the checkpoint retention window —
+    with a coarser ``--checkpoint_replay_every`` cadence the LATEST state
+    checkpoint usually lacks the payload and resume silently restarted
+    with an empty buffer). Stamped with the learner step it was taken at.
+    Write-then-rename so a crash mid-save leaves the previous snapshot
+    intact."""
     import pickle
 
     path = _host_replay_path(run_dir, process_index)
@@ -181,24 +184,40 @@ def _save_host_replay(run_dir: str, process_index: int, step: int,
 
 
 def _load_host_replay(run_dir: str, process_index: int,
-                      step: int) -> dict | None:
-    """Load this host's replay sidecar IF it matches the restored learner
-    step — a shard from another save moment (e.g. the state checkpoint is
-    newer than the last replay-due save) would silently mix replay
-    timelines across hosts."""
+                      step: int) -> tuple[dict | None, int]:
+    """Load this host's replay sidecar; returns ``(snap, snap_step)``
+    (``(None, -1)`` when absent/refused). A snapshot OLDER than the
+    restored state is accepted with a warning — stale rows are still
+    valid experience, and an almost-full slightly-stale buffer resumes
+    far better than an empty one (the strict-equality rule this replaces
+    emptied the buffer whenever the replay cadence was coarser than the
+    state cadence). A snapshot NEWER than the state is refused: the save
+    site commits the state checkpoint BEFORE renaming the sidecar, so
+    ahead-of-state means mixed-up run dirs or a rolled-back checkpoint.
+    Multi-host fused restores additionally require the snapshot step to
+    AGREE across hosts (see the resume site) — per-host staleness is
+    fine for independent host buffers, but the sharded device buffer is
+    one logical store whose shard-sets must come from one save moment."""
     import pickle
 
     path = _host_replay_path(run_dir, process_index)
     if not os.path.exists(path):
-        return None
+        return None, -1
     with open(path, "rb") as f:
         payload = pickle.load(f)
-    if int(payload.get("step", -1)) != int(step):
+    snap_step = int(payload.get("step", -1))
+    if snap_step > int(step):
         print(f"[p{process_index}] replay sidecar is from step "
-              f"{payload.get('step')} but the restored state is at step "
-              f"{step}; starting with an empty shard", flush=True)
-        return None
-    return payload["snap"]
+              f"{snap_step}, AHEAD of the restored state at step {step}; "
+              "refusing it (mixed run dirs?) — starting with an empty "
+              "buffer", flush=True)
+        return None, -1
+    if snap_step < int(step):
+        print(f"[p{process_index}] replay sidecar is from step "
+              f"{snap_step} ({int(step) - snap_step} steps behind the "
+              "restored state); resuming with the slightly-stale buffer",
+              flush=True)
+    return payload["snap"], snap_step
 
 
 def train(cfg: ExperimentConfig) -> dict:
@@ -445,23 +464,35 @@ def train(cfg: ExperimentConfig) -> dict:
                         "clip": float(payload[-2]), "eps": float(payload[-1]),
                     }
             restored_step = int(np.asarray(raw["step"]))
-            snap = (extra.pop("replay", None) if is_main
-                    else _load_host_replay(run_dir, jax.process_index(),
-                                           restored_step))
+            # every host restores from its sidecar; a legacy checkpoint
+            # may still carry process 0's buffer in the Orbax extra
+            # (saved atomically with the state, so its step IS the state's)
+            snap, snap_step = (extra.pop("replay", None), restored_step) \
+                if is_main and extra.get("replay") else (None, -1)
+            if snap is None:
+                snap, snap_step = _load_host_replay(
+                    run_dir, jax.process_index(), restored_step)
             if fused:
-                # the sharded fused restore path is COLLECTIVE (its drain
-                # allgathers); a host loading while a peer with a missing/
-                # stale sidecar skips would deadlock — agree first, and on
-                # disagreement all hosts start with empty replay
-                all_have = int(np.min(multihost_utils.process_allgather(
-                    np.int32(1 if snap else 0))))
-                if all_have:
+                # The sharded fused restore is COLLECTIVE downstream (the
+                # next drain allgathers), and the device buffer is ONE
+                # logical store: every host's shard-set must come from
+                # the SAME save moment. Agree on the snapshot step — a
+                # host that crashed between its peers' sidecar renames
+                # holds an older one, and loading mixed-step shard-sets
+                # would silently mix replay timelines (rows, priorities,
+                # size counters) within one buffer. On any mismatch or
+                # missing snapshot, ALL hosts restart with empty replay.
+                steps_all = multihost_utils.process_allgather(
+                    np.int64(snap_step))
+                agreed = (int(steps_all.min()) == int(steps_all.max())
+                          and int(steps_all.min()) >= 0)
+                if agreed:
                     service.load_replay_state(snap)
-                elif snap:
-                    print(f"[p{jax.process_index()}] a peer host is missing "
-                          "its replay sidecar; all hosts restart with empty "
-                          "replay", flush=True)
-            elif snap:
+                elif snap is not None:
+                    print(f"[p{jax.process_index()}] replay sidecar steps "
+                          f"disagree across hosts ({steps_all.tolist()}); "
+                          "all hosts restart with empty replay", flush=True)
+            elif snap is not None:
                 service.load_replay_state(snap)
             print(f"[p{jax.process_index()}] resumed from step "
                   f"{int(jax.device_get(state.step))} ({service.env_steps} "
@@ -471,10 +502,16 @@ def train(cfg: ExperimentConfig) -> dict:
         if mesh is not None:
             state = replicate_state(state, mesh)
         service.set_env_steps(extra.get("env_steps", 0))
-        if extra.get("replay"):
-            # exact elastic recovery: buffer contents + PER priorities
-            # (resumed learners otherwise retrain from an empty buffer)
-            service.load_replay_state(extra.pop("replay"))
+        # elastic recovery: buffer contents + PER priorities (resumed
+        # learners otherwise retrain from an empty buffer). Legacy
+        # checkpoints carry the buffer in the Orbax extra; current runs
+        # write the step-stamped sidecar (stale-tolerant — see
+        # _load_host_replay).
+        snap = extra.pop("replay", None)
+        if snap is None:
+            snap, _ = _load_host_replay(run_dir, 0, int(state.step))
+        if snap:
+            service.load_replay_state(snap)
         print(f"resumed from step {int(state.step)} "
               f"({service.env_steps} env steps, "
               f"{len(service)} replay rows)")
@@ -1006,19 +1043,26 @@ def train(cfg: ExperimentConfig) -> dict:
                     extra_payload = {"env_steps": service.env_steps}
                     if obs_norm is not None:
                         extra_payload["obs_norm"] = obs_norm.state_dict()
-                    if replay_due:
-                        # coarser cadence than the state checkpoint: the
-                        # ring snapshot holds the buffer lock and (device
-                        # storage) pays a full D2H copy
-                        extra_payload["replay"] = service.replay_state()
                     ckpt.save(
                         state if mesh is None else jax.device_get(state),
                         extra=extra_payload,
                     )
-                elif multi_host and replay_due:
-                    # hosts > 0: the learner state is process 0's to save
-                    # (it is replicated), but each host's replay shard is
-                    # its own — sidecar snapshot for multi-host resume
+                if replay_due:
+                    if ckpt is not None:
+                        # durability order: the state checkpoint commits
+                        # BEFORE the sidecar rename (Orbax saves async) —
+                        # a crash in this window must never leave a
+                        # sidecar AHEAD of the latest durable state, which
+                        # restore would refuse, emptying the buffer (the
+                        # exact failure the sidecar exists to prevent)
+                        ckpt.wait()
+                    # every host's buffer goes to its step-stamped sidecar
+                    # (process 0 included) at a coarser cadence than the
+                    # state checkpoint — the ring snapshot holds the buffer
+                    # lock and (device storage) pays a full D2H copy.
+                    # Restore tolerates the resulting staleness; an Orbax
+                    # extra payload would instead vanish whenever the
+                    # retention window outran the replay cadence.
                     _save_host_replay(run_dir, jax.process_index(), lstep,
                                       service.replay_state())
     stop_actors.set()

@@ -237,3 +237,69 @@ def test_strict_reference_mode():
     # default mode keeps the documented divergence
     d = parse_args(["--env", "Pendulum-v1"]).resolve()
     assert d.v_min == -100.0 and d.reward_scale == 0.1
+
+
+def test_host_replay_sidecar_staleness_rules(tmp_path):
+    """The step-stamped replay sidecar: an OLDER snapshot than the
+    restored state is accepted (stale rows are valid experience; the old
+    strict-equality rule emptied the buffer whenever the replay cadence
+    was coarser than the state cadence), a NEWER one is refused (the
+    save site commits state before the sidecar rename, so ahead-of-state
+    means mixed run dirs)."""
+    from d4pg_tpu.train import _load_host_replay, _save_host_replay
+
+    snap = {"rows": "payload"}
+    _save_host_replay(str(tmp_path), 0, step=100, snap=snap)
+    # exact match
+    got, step = _load_host_replay(str(tmp_path), 0, step=100)
+    assert got == snap and step == 100
+    # stale (older than state): accepted
+    got, step = _load_host_replay(str(tmp_path), 0, step=160)
+    assert got == snap and step == 100
+    # ahead of state: refused
+    got, step = _load_host_replay(str(tmp_path), 0, step=40)
+    assert got is None and step == -1
+    # absent
+    got, step = _load_host_replay(str(tmp_path), 7, step=100)
+    assert got is None and step == -1
+
+
+def test_single_host_resume_reads_stale_sidecar(tmp_path):
+    """Resume restores the buffer from the sidecar even when the replay
+    cadence was coarser than the state cadence — the round-4 failure
+    mode: the LATEST state checkpoint used to be the only replay source,
+    so 4 out of 5 resumes silently restarted with an empty buffer."""
+    import re
+
+    from d4pg_tpu.config import ExperimentConfig
+    from d4pg_tpu.train import train
+
+    def run(resume):
+        cfg = ExperimentConfig(
+            env="point", max_steps=20, num_envs=2, warmup=100, n_epochs=1,
+            n_cycles=4, episodes_per_cycle=1, train_steps_per_cycle=8,
+            eval_trials=1, batch_size=16, memory_size=2000,
+            log_dir=str(tmp_path), hidden=(16, 16), n_atoms=11,
+            v_min=-5.0, v_max=0.0, checkpoint_replay=True,
+            # replay saved only every 3rd save; state saved every cycle —
+            # the LAST state checkpoint (cycle 4) has no replay save
+            checkpoint_replay_every=3, resume=resume,
+        )
+        return train(cfg)
+
+    run(False)
+    run_dirs = [d for d in os.listdir(tmp_path) if d.startswith("exp_")]
+    sidecar = os.path.join(tmp_path, run_dirs[0], "replay_p0.pkl")
+    assert os.path.exists(sidecar)
+    import io as _io
+    from contextlib import redirect_stdout
+
+    buf = _io.StringIO()
+    with redirect_stdout(buf):
+        run(True)
+    out = buf.getvalue()
+    m = re.search(r"resumed from step (\d+) \((\d+) env steps, (\d+) replay rows", out)
+    assert m, out[-2000:]
+    assert int(m.group(1)) == 32  # restored latest state (4 cycles x 8)
+    assert int(m.group(3)) > 0   # buffer restored from the STALE sidecar
+    assert "steps behind the restored state" in out

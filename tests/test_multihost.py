@@ -170,9 +170,10 @@ def test_two_process_fused_full_train_and_resume(tmp_path):
     finals = [out.rsplit("final:", 1)[1].split("critic_loss': ")[1]
                  .split(",")[0] for out in outs]
     assert finals[0] == finals[1], finals
-    # both hosts wrote their replay shard (p0 via Orbax extra, p1 sidecar)
+    # EVERY host wrote its replay shard sidecar, process 0 included
     run_dirs = [d for d in os.listdir(tmp_path) if d.startswith("exp_")]
     assert len(run_dirs) == 1
+    assert os.path.exists(os.path.join(tmp_path, run_dirs[0], "replay_p0.pkl"))
     assert os.path.exists(os.path.join(tmp_path, run_dirs[0], "replay_p1.pkl"))
 
     outs = launch(["--resume", "1"])
