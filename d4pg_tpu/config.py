@@ -41,6 +41,11 @@ class ExperimentConfig:
     # velocities are invisible); 3 is the DrQ/D4PG-pixels convention and
     # the right setting for dm_control pixel control.
     frame_stack: int = 1
+    # DrQ random-shift augmentation inside the jit'd update (pixel envs
+    # only): 'none' or 'shift'; the shift radius should roughly scale with
+    # the frame size (DrQ's 4px is calibrated to 84px frames)
+    augment: str = "none"
+    augment_pad: int = 4
     reward_scale: float = 1.0
     # replay
     memory_size: int = 1_000_000  # --rmsize
@@ -232,6 +237,8 @@ class ExperimentConfig:
             hidden=tuple(self.hidden),
             critic_family=self.critic_family,
             projection=self.projection,
+            augment=self.augment,
+            augment_pad=self.augment_pad,
             encoder_channels=(self.encoder_width,) * 4,
             lr_actor=self.lr_actor,
             lr_critic=self.lr_critic,
@@ -271,6 +278,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="frames stacked channel-wise for pixel envs "
                         "(1 = raw frames; 3 = DrQ/D4PG-pixels convention "
                         "— single frames hide velocities)")
+    p.add_argument("--augment", choices=("none", "shift"), default=d.augment,
+                   help="batch image augmentation in the update (pixel "
+                        "envs): 'shift' = DrQ random shift")
+    p.add_argument("--augment_pad", type=int, default=d.augment_pad,
+                   help="shift radius in pixels (DrQ uses 4 at 84px; "
+                        "scale with --pixel_size)")
     p.add_argument("--rmsize", type=int, default=d.memory_size, dest="memory_size")
     p.add_argument("--bsize", type=int, default=d.batch_size, dest="batch_size")
     p.add_argument("--warmup", type=int, default=d.warmup)

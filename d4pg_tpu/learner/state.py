@@ -64,6 +64,11 @@ class D4PGConfig:
     pixels: bool = False  # conv-encoder path (BASELINE.md config #4)
     obs_shape: tuple = ()  # [H, W, C] when pixels=True
     encoder_channels: tuple = (32, 32, 32, 32)  # conv widths (pixels only)
+    # batch augmentation inside the jit'd update (pixels only): 'none' or
+    # 'shift' (DrQ random shift, ops/augment.py — the standard antidote to
+    # conv-encoder overfitting at small replay scales)
+    augment: str = "none"
+    augment_pad: int = 4  # DrQ's +-4px shift radius
     mog_samples: int = 32
     # MXU compute dtype for the network matmuls ('float32' | 'bfloat16').
     # Params, optimizer state, losses and the projection stay float32;
@@ -91,6 +96,17 @@ class D4PGConfig:
             raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
         if self.projection not in ("einsum", "pallas", "pallas_ce"):
             raise ValueError(f"unknown projection {self.projection!r}")
+        if self.augment not in ("none", "shift"):
+            raise ValueError(f"unknown augment {self.augment!r}")
+        if self.augment != "none" and not self.pixels:
+            raise ValueError(
+                "--augment is an image augmentation; it requires the "
+                "pixel (conv-encoder) observation path")
+        if self.augment != "none" and self.augment_pad < 1:
+            raise ValueError(
+                f"--augment {self.augment} with augment_pad="
+                f"{self.augment_pad} would silently train UNaugmented; "
+                "set a positive shift radius (or --augment none)")
 
     @property
     def _dtype(self):

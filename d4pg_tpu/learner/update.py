@@ -171,6 +171,20 @@ def update_step(
     """
     key, sub = jax.random.split(state.key)
 
+    if config.augment == "shift":
+        # DrQ random shift on the sampled rows (ops/augment.py): both
+        # losses see the same augmented view; obs and next_obs get
+        # independent shifts (DrQ's convention — the target should not
+        # share the online view's crop)
+        sub, k_obs, k_next = jax.random.split(sub, 3)
+        from d4pg_tpu.ops.augment import random_shift
+
+        batch = batch._replace(
+            obs=random_shift(k_obs, batch.obs, config.augment_pad),
+            next_obs=random_shift(k_next, batch.next_obs,
+                                  config.augment_pad),
+        )
+
     # --- critic step -----------------------------------------------------
     (critic_loss, td_error), critic_grads = jax.value_and_grad(
         lambda p: _critic_loss_fn(config, p, state, batch, is_weights, sub),

@@ -1,0 +1,49 @@
+"""Image augmentation for pixel-observation learners: DrQ random shift.
+
+The single highest-leverage ingredient in published pixel continuous
+control at small data budgets (DrQ / RAD): pad the frame by ``pad``
+pixels with edge replication, then take a per-sample random crop back to
+the original size. Regularizes the conv encoder against the tiny-replay
+overfitting that keeps greedy returns at the random-policy level (the
+exact failure measured in ``docs/evidence/dmc-pixels/``).
+
+Applied INSIDE the jit'd update (``learner/update.py``) on the sampled
+batch — uint8 rows stay uint8 through the shift, so the replay ring and
+the H2D path are untouched; both the critic and actor losses see the
+same augmented view (the one-sample DrQ variant, M=K=1). The reference
+has no pixel path at all (``models.py:15`` is state-only).
+
+Pure ``lax`` ops (pad + per-sample dynamic_slice under ``vmap``), so the
+augmentation shards over the batch axis under GSPMD like every other
+per-sample op.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def random_shift(key: Array, imgs: Array, pad: int = 4) -> Array:
+    """Per-sample random shift of a [B, H, W, C] image batch.
+
+    Each sample is edge-padded by ``pad`` on both spatial axes and
+    re-cropped to [H, W] at an offset drawn uniformly from
+    ``[0, 2*pad]^2`` — i.e. a shift of up to ``pad`` pixels in any
+    direction, with edge-replicated fill. dtype-preserving (uint8 in,
+    uint8 out)."""
+    if imgs.ndim != 4:
+        raise ValueError(f"random_shift expects [B, H, W, C], got "
+                         f"{imgs.shape}")
+    if pad < 1:
+        return imgs
+    b, h, w, c = imgs.shape
+    padded = jnp.pad(imgs, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                     mode="edge")
+    offsets = jax.random.randint(key, (b, 2), 0, 2 * pad + 1)
+
+    def crop(img, off):
+        return jax.lax.dynamic_slice(img, (off[0], off[1], 0), (h, w, c))
+
+    return jax.vmap(crop)(padded, offsets)
