@@ -1,0 +1,29 @@
+#!/bin/bash
+# Crash-tolerant run supervisor for long evidence runs on this image.
+#
+# The in-process dm_control renderer (Mesa swrast on this EGL-less VM)
+# can GPF the whole training process (see docs/evidence/dmc-pixels/
+# README.md, round 4) — a native-library hazard, not a framework bug.
+# This wrapper turns such a crash into a resume: every segment runs
+# with --resume 1 against the same run dir, so a restart continues
+# from the latest Orbax checkpoint + step-stamped replay sidecar.
+#
+# Usage: run_supervised.sh <max_restarts> <logfile> -- <train args...>
+# Stops when the training process exits 0 (run complete) or the
+# restart budget is exhausted (persistently failing config).
+set -u
+MAX=$1; LOG=$2; shift 2
+[ "$1" = "--" ] && shift
+n=0
+while true; do
+  python -m d4pg_tpu.train "$@" >>"$LOG" 2>&1
+  code=$?
+  if [ $code -eq 0 ]; then echo "[supervisor] run complete" >>"$LOG"; exit 0; fi
+  n=$((n+1))
+  if [ $n -gt "$MAX" ]; then
+    echo "[supervisor] exit $code; restart budget ($MAX) exhausted" >>"$LOG"
+    exit $code
+  fi
+  echo "[supervisor] exit $code; restart $n/$MAX in 10s" >>"$LOG"
+  sleep 10
+done
