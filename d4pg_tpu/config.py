@@ -46,6 +46,9 @@ class ExperimentConfig:
     # the frame size (DrQ's 4px is calibrated to 84px frames)
     augment: str = "none"
     augment_pad: int = 4
+    # tie the actor's conv encoder to the critic's, trained by the critic
+    # loss only (SAC-AE/DrQ; pixels only — see learner/state.py)
+    share_encoder: bool = False
     reward_scale: float = 1.0
     # replay
     memory_size: int = 1_000_000  # --rmsize
@@ -239,6 +242,7 @@ class ExperimentConfig:
             projection=self.projection,
             augment=self.augment,
             augment_pad=self.augment_pad,
+            share_encoder=self.share_encoder,
             encoder_channels=(self.encoder_width,) * 4,
             lr_actor=self.lr_actor,
             lr_critic=self.lr_critic,
@@ -284,6 +288,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--augment_pad", type=int, default=d.augment_pad,
                    help="shift radius in pixels (DrQ uses 4 at 84px; "
                         "scale with --pixel_size)")
+    _add_bool_flag(p, "share_encoder", d.share_encoder,
+                   "critic-trained shared conv encoder (SAC-AE/DrQ; "
+                   "pixel envs)")
     p.add_argument("--rmsize", type=int, default=d.memory_size, dest="memory_size")
     p.add_argument("--bsize", type=int, default=d.batch_size, dest="batch_size")
     p.add_argument("--warmup", type=int, default=d.warmup)

@@ -32,3 +32,13 @@ def hard_update(target: T, online: T) -> T:
     """
     del target
     return jax.tree_util.tree_map(jnp.copy, online)
+
+
+def tie_encoder(actor_params, critic_params):
+    """Replace the actor's ``encoder`` subtree with the critic's
+    (``--share_encoder``, SAC-AE/DrQ: the conv encoder is trained by the
+    critic loss alone). One definition for every tie site — init, the
+    per-step online tie, and the target tie — so the param-tree layout
+    assumption lives in exactly one place."""
+    return {"params": {**actor_params["params"],
+                       "encoder": critic_params["params"]["encoder"]}}

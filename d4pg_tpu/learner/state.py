@@ -20,7 +20,7 @@ import optax
 from jax import Array
 
 from d4pg_tpu.core.distribution import CategoricalSupport
-from d4pg_tpu.core.updates import hard_update
+from d4pg_tpu.core.updates import hard_update, tie_encoder
 from d4pg_tpu.models.actor import Actor
 from d4pg_tpu.models.critic import CategoricalCritic, MixtureOfGaussianCritic
 from d4pg_tpu.models.encoder import PixelActor, PixelCategoricalCritic
@@ -69,6 +69,17 @@ class D4PGConfig:
     # conv-encoder overfitting at small replay scales)
     augment: str = "none"
     augment_pad: int = 4  # DrQ's +-4px shift radius
+    # Share the conv encoder between critic and actor (pixels only): the
+    # encoder is trained by the CRITIC loss alone; the actor consumes it
+    # through a stop-gradient and its own encoder subtree is hard-tied to
+    # the critic's after every critic step. This is the SAC-AE/DrQ result
+    # that makes pixel control work at small data scales — actor-gradient
+    # -trained conv encoders optimize their losses while greedy returns
+    # stay at the random-policy level (measured: docs/evidence/dmc-pixels/).
+    # Param-tree layout is unchanged (the actor still CARRIES an encoder
+    # subtree, it is just tied), so acting, weight publishing, checkpoints
+    # and resume are oblivious; a run can even flip the flag mid-stream.
+    share_encoder: bool = False
     mog_samples: int = 32
     # MXU compute dtype for the network matmuls ('float32' | 'bfloat16').
     # Params, optimizer state, losses and the projection stay float32;
@@ -107,6 +118,12 @@ class D4PGConfig:
                 f"--augment {self.augment} with augment_pad="
                 f"{self.augment_pad} would silently train UNaugmented; "
                 "set a positive shift radius (or --augment none)")
+        if self.share_encoder and not (
+                self.pixels and self.critic_family == "categorical"):
+            raise ValueError(
+                "--share_encoder ties the actor's conv encoder to the "
+                "critic's; it requires the pixel path with the "
+                "categorical critic")
 
     @property
     def _dtype(self):
@@ -123,8 +140,11 @@ class D4PGConfig:
 
     def build_actor(self) -> nn.Module:
         if self.pixels:
+            # share_encoder => the policy loss must not train the (tied)
+            # encoder: stop the gradient at the latent. Same param tree.
             return PixelActor(self.act_dim, channels=self.encoder_channels,
-                              hidden=self.hidden, dtype=self._dtype)
+                              hidden=self.hidden, dtype=self._dtype,
+                              detach_encoder=self.share_encoder)
         return Actor(self.act_dim, hidden=self.hidden, dtype=self._dtype)
 
     def build_critic(self) -> nn.Module:
@@ -168,6 +188,12 @@ def init_state(config: D4PGConfig, key: Array) -> D4PGState:
     act = jnp.zeros((1, config.act_dim), jnp.float32)
     actor_params = config.build_actor().init(k_actor, obs)
     critic_params = config.build_critic().init(k_critic, obs, act)
+    if config.share_encoder:
+        # the tie holds from step 0: otherwise the target actor starts as
+        # a hard copy of an UNRELATED random encoder and the mismatch only
+        # decays at (1-tau)^t through the soft updates (~thousands of
+        # early bootstrap targets through a wrong encoder/MLP pairing)
+        actor_params = tie_encoder(actor_params, critic_params)
     return D4PGState(
         actor_params=actor_params,
         critic_params=critic_params,

@@ -38,7 +38,7 @@ from d4pg_tpu.core.losses import (
     expected_q,
     weighted_mean,
 )
-from d4pg_tpu.core.updates import soft_update
+from d4pg_tpu.core.updates import soft_update, tie_encoder
 from d4pg_tpu.learner.state import D4PGConfig, D4PGState
 from d4pg_tpu.replay.uniform import TransitionBatch
 
@@ -146,6 +146,10 @@ def _actor_loss_fn(
     discouraging saturated tanh actions on sparse-reward manipulation
     tasks. With ``action_l2 > 0`` the reported ``actor_loss`` / ``q_mean``
     metrics include the penalty term."""
+    # With share_encoder the actor module stops the gradient at the
+    # latent (PixelActor.detach_encoder — SAC-AE/DrQ: the policy loss
+    # trains ONLY the actor MLP; the tied encoder learns from the critic
+    # loss alone, see the tie in update_step).
     actor = config.build_actor()
     critic = config.build_critic()
     action = actor.apply(actor_params, batch.obs)
@@ -195,6 +199,21 @@ def update_step(
     )
     critic_params = optax.apply_updates(state.critic_params, critic_updates)
 
+    # --- shared-encoder tie (SAC-AE/DrQ): the actor's encoder subtree IS
+    # the critic's, refreshed right after the critic step. Done on the
+    # params the actor step reads, and RE-asserted after apply_updates
+    # below, so the invariant holds even when the actor Adam carries
+    # nonzero encoder moments — e.g. a run that flipped --share_encoder
+    # on when resuming an unshared checkpoint (stale moments keep
+    # emitting decaying updates for many steps; overwriting, not
+    # masking, makes that unobservable). The TARGET actor's encoder is
+    # likewise tied to the TARGET critic's in the soft-update step — a
+    # no-op for a shared-from-init run (identical EMA sequences) that
+    # makes the mid-run flip exact rather than (1-tau)^t-transient.
+    actor_params_in = (
+        tie_encoder(state.actor_params, critic_params)
+        if config.share_encoder else state.actor_params)
+
     # --- actor step. Documented divergence: the policy loss here flows
     # through the critic params the critic Adam step just produced. The
     # reference computes it with its LOCAL critic, which at that point
@@ -206,22 +225,29 @@ def update_step(
     # ``learner/state.py:34-41``). -----------------------------------------
     actor_loss, actor_grads = jax.value_and_grad(
         lambda p: _actor_loss_fn(config, p, critic_params, batch)
-    )(state.actor_params)
+    )(actor_params_in)
     actor_updates, actor_opt_state = config.optimizer(config.lr_actor).update(
-        actor_grads, state.actor_opt_state, state.actor_params
+        actor_grads, state.actor_opt_state, actor_params_in
     )
-    actor_params = optax.apply_updates(state.actor_params, actor_updates)
+    actor_params = optax.apply_updates(actor_params_in, actor_updates)
+    if config.share_encoder:
+        actor_params = tie_encoder(actor_params, critic_params)
 
     # --- soft target updates (tau, ``ddpg.py:110-116``) -------------------
+    target_actor_params = soft_update(
+        state.target_actor_params, actor_params, config.tau
+    )
+    target_critic_params = soft_update(
+        state.target_critic_params, critic_params, config.tau
+    )
+    if config.share_encoder:
+        target_actor_params = tie_encoder(
+            target_actor_params, target_critic_params)
     new_state = D4PGState(
         actor_params=actor_params,
         critic_params=critic_params,
-        target_actor_params=soft_update(
-            state.target_actor_params, actor_params, config.tau
-        ),
-        target_critic_params=soft_update(
-            state.target_critic_params, critic_params, config.tau
-        ),
+        target_actor_params=target_actor_params,
+        target_critic_params=target_critic_params,
         actor_opt_state=actor_opt_state,
         critic_opt_state=critic_opt_state,
         key=key,

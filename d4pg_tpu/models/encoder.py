@@ -17,6 +17,7 @@ from __future__ import annotations
 from typing import Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from d4pg_tpu.models.actor import Actor
@@ -45,18 +46,26 @@ class PixelEncoder(nn.Module):
 
 
 class PixelActor(nn.Module):
-    """Encoder + MLP actor for pixel observations."""
+    """Encoder + MLP actor for pixel observations.
+
+    ``detach_encoder`` stops the gradient at the latent (SAC-AE/DrQ: the
+    policy loss must not train the conv encoder — ``--share_encoder``
+    ties this module's encoder subtree to the critic's, which the critic
+    loss trains). The param tree is identical either way."""
 
     act_dim: int
     latent_dim: int = 50
     channels: Sequence[int] = (32, 32, 32, 32)
     hidden: Sequence[int] = (256, 256, 256)
     dtype: jnp.dtype = jnp.float32
+    detach_encoder: bool = False
 
     @nn.compact
     def __call__(self, pixels: jnp.ndarray) -> jnp.ndarray:
         z = PixelEncoder(self.latent_dim, tuple(self.channels),
                          dtype=self.dtype, name="encoder")(pixels)
+        if self.detach_encoder:
+            z = jax.lax.stop_gradient(z)
         return Actor(self.act_dim, self.hidden, dtype=self.dtype, name="actor")(z)
 
 

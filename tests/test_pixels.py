@@ -141,3 +141,114 @@ def test_frame_stack_train_smoke(tmp_path):
     assert obs_dim == (16, 16, 9) and obs_dtype == np.uint8
     metrics = train(cfg)
     assert np.isfinite(metrics["critic_loss"])
+
+
+def test_shared_encoder_tie_and_detached_policy(rng):
+    """--share_encoder (SAC-AE/DrQ): after every update the actor's
+    encoder subtree is bitwise the critic's (trained by the critic loss
+    alone), the policy gradient never moves it (actor Adam moments for
+    the subtree stay exactly zero), and the actor MLP still trains."""
+    config = D4PGConfig(
+        obs_dim=int(np.prod(SHAPE)), act_dim=2, v_min=-20.0, v_max=0.0,
+        n_atoms=11, hidden=(32, 32), pixels=True, obs_shape=SHAPE,
+        encoder_channels=(8, 8, 8, 8), share_encoder=True,
+    )
+    state = init_state(config, jax.random.key(0))
+    update = make_update(config, donate=False, use_is_weights=False)
+    n = 8
+    batch = TransitionBatch(
+        obs=rng.integers(0, 255, (n, *SHAPE), dtype=np.uint8),
+        action=rng.uniform(-1, 1, (n, 2)).astype(np.float32),
+        reward=rng.standard_normal(n).astype(np.float32),
+        next_obs=rng.integers(0, 255, (n, *SHAPE), dtype=np.uint8),
+        done=np.zeros(n, np.float32),
+        discount=np.full(n, 0.99, np.float32),
+    )
+    prev = state
+    for _ in range(2):
+        state, metrics = update(state, batch)
+    tree = jax.tree_util.tree_leaves
+    for a, c in zip(tree(state.actor_params["params"]["encoder"]),
+                    tree(state.critic_params["params"]["encoder"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+    # encoder DID train (via the critic loss), actor MLP DID train
+    assert any(
+        np.any(np.asarray(a) != np.asarray(b))
+        for a, b in zip(tree(prev.critic_params["params"]["encoder"]),
+                        tree(state.critic_params["params"]["encoder"])))
+    assert any(
+        np.any(np.asarray(a) != np.asarray(b))
+        for a, b in zip(tree(prev.actor_params["params"]["actor"]),
+                        tree(state.actor_params["params"]["actor"])))
+    # the policy loss is detached from the encoder: its Adam moments for
+    # the tied subtree are exactly zero after real update steps
+    mu = state.actor_opt_state[0].mu["params"]["encoder"]
+    assert all(np.all(np.asarray(x) == 0) for x in tree(mu))
+    assert np.isfinite(float(metrics["actor_loss"]))
+
+
+def test_shared_encoder_tie_survives_warm_moments(rng):
+    """Flipping --share_encoder ON over a resumed UNshared checkpoint
+    leaves stale nonzero actor-Adam moments for the encoder subtree;
+    those emit decaying updates for many steps. The tie is re-asserted
+    after apply_updates, so the published actor encoder stays bitwise
+    the critic's anyway."""
+    kw = dict(
+        obs_dim=int(np.prod(SHAPE)), act_dim=2, v_min=-20.0, v_max=0.0,
+        n_atoms=11, hidden=(32, 32), pixels=True, obs_shape=SHAPE,
+        encoder_channels=(8, 8, 8, 8),
+    )
+    n = 8
+    batch = TransitionBatch(
+        obs=rng.integers(0, 255, (n, *SHAPE), dtype=np.uint8),
+        action=rng.uniform(-1, 1, (n, 2)).astype(np.float32),
+        reward=rng.standard_normal(n).astype(np.float32),
+        next_obs=rng.integers(0, 255, (n, *SHAPE), dtype=np.uint8),
+        done=np.zeros(n, np.float32),
+        discount=np.full(n, 0.99, np.float32),
+    )
+    # a few UNshared steps build nonzero encoder moments in the actor Adam
+    unshared = D4PGConfig(**kw)
+    state = init_state(unshared, jax.random.key(0))
+    update = make_update(unshared, donate=False, use_is_weights=False)
+    for _ in range(3):
+        state, _ = update(state, batch)
+    tree = jax.tree_util.tree_leaves
+    mu = state.actor_opt_state[0].mu["params"]["encoder"]
+    assert any(np.any(np.asarray(x) != 0) for x in tree(mu))
+    # "resume" the same state with the flag flipped on
+    shared = D4PGConfig(**kw, share_encoder=True)
+    update_shared = make_update(shared, donate=False, use_is_weights=False)
+    for _ in range(2):
+        state, _ = update_shared(state, batch)
+        # online AND target tie hold immediately after the flip — the
+        # target tie must not be left to the (1-tau)^t soft-update decay
+        for a, c in zip(tree(state.actor_params["params"]["encoder"]),
+                        tree(state.critic_params["params"]["encoder"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+        for a, c in zip(
+                tree(state.target_actor_params["params"]["encoder"]),
+                tree(state.target_critic_params["params"]["encoder"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_shared_encoder_requires_pixel_categorical():
+    with pytest.raises(ValueError, match="share_encoder"):
+        D4PGConfig(obs_dim=4, act_dim=2, share_encoder=True)
+
+
+def test_shared_encoder_tied_from_init():
+    """The tie holds from step 0 (targets included): a fresh shared init
+    must not spend ~1/tau steps bootstrapping through a random unrelated
+    actor encoder."""
+    config = D4PGConfig(
+        obs_dim=int(np.prod(SHAPE)), act_dim=2, v_min=-20.0, v_max=0.0,
+        n_atoms=11, hidden=(32, 32), pixels=True, obs_shape=SHAPE,
+        encoder_channels=(8, 8, 8, 8), share_encoder=True,
+    )
+    state = init_state(config, jax.random.key(0))
+    tree = jax.tree_util.tree_leaves
+    for params in (state.actor_params, state.target_actor_params):
+        for a, c in zip(tree(params["params"]["encoder"]),
+                        tree(state.critic_params["params"]["encoder"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
