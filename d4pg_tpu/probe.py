@@ -23,7 +23,13 @@ import os
 import subprocess
 import sys
 
-_CHILD = "import jax; print(jax.devices()[0].platform)"
+# The child must DISPATCH a computation, not just enumerate devices:
+# jax.devices() succeeds on a libtpu-version-mismatched chip while the
+# first apply_primitive raises FAILED_PRECONDITION (MULTICHIP_r04's
+# failure). Only a completed jitted op proves the backend usable.
+_CHILD = ("import jax; "
+          "jax.block_until_ready(jax.jit(lambda x: x + 1)(1.0)); "
+          "print(jax.devices()[0].platform)")
 
 
 def probe_platform(timeout: float = 90.0) -> str:
