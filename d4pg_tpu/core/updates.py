@@ -39,6 +39,16 @@ def tie_encoder(actor_params, critic_params):
     (``--share_encoder``, SAC-AE/DrQ: the conv encoder is trained by the
     critic loss alone). One definition for every tie site — init, the
     per-step online tie, and the target tie — so the param-tree layout
-    assumption lives in exactly one place."""
-    return {"params": {**actor_params["params"],
-                       "encoder": critic_params["params"]["encoder"]}}
+    assumption lives in exactly one place.
+
+    The tied subtree is COPIED, not aliased: an aliased buffer appears in
+    both donated param trees of the jit'd update, and XLA rejects donating
+    the same buffer twice (``--share_encoder`` with ``make_multi_update``
+    crashed on exactly this). ``jnp.copy`` is identity for autodiff and
+    costs ~µs per step against the conv forward/backward it rides with.
+    Collections other than 'params' (e.g. a future encoder's batch_stats)
+    are preserved from the actor tree untouched."""
+    return {**actor_params,
+            "params": {**actor_params["params"],
+                       "encoder": jax.tree_util.tree_map(
+                           jnp.copy, critic_params["params"]["encoder"])}}

@@ -187,6 +187,41 @@ def test_shared_encoder_tie_and_detached_policy(rng):
     assert np.isfinite(float(metrics["actor_loss"]))
 
 
+def test_shared_encoder_multi_update_donation(rng):
+    """Regression (round 5): the tied encoder subtree must be a COPY, not
+    an alias — an aliased buffer appears in both donated param trees of
+    the K-scan update and XLA rejects donating the same buffer twice
+    (--share_encoder + --updates_per_dispatch>1 crashed at dispatch)."""
+    from d4pg_tpu.learner import make_multi_update
+
+    config = D4PGConfig(
+        obs_dim=int(np.prod(SHAPE)), act_dim=2, v_min=-20.0, v_max=0.0,
+        n_atoms=11, hidden=(32, 32), pixels=True, obs_shape=SHAPE,
+        encoder_channels=(8, 8, 8, 8), share_encoder=True,
+    )
+    state = init_state(config, jax.random.key(0))
+    update = make_multi_update(config, donate=True, use_is_weights=False)
+    k, n = 2, 8
+    batch = TransitionBatch(
+        obs=rng.integers(0, 255, (k, n, *SHAPE), dtype=np.uint8),
+        action=rng.uniform(-1, 1, (k, n, 2)).astype(np.float32),
+        reward=rng.standard_normal((k, n)).astype(np.float32),
+        next_obs=rng.integers(0, 255, (k, n, *SHAPE), dtype=np.uint8),
+        done=np.zeros((k, n), np.float32),
+        discount=np.full((k, n), 0.99, np.float32),
+    )
+    # two consecutive donated dispatches: the second consumes the first's
+    # outputs as donated inputs — where aliased subtrees blow up
+    for _ in range(2):
+        state, metrics = update(state, batch)
+    jax.block_until_ready(metrics["critic_loss"])
+    assert np.isfinite(np.asarray(metrics["critic_loss"])).all()
+    tree = jax.tree_util.tree_leaves
+    for a, c in zip(tree(state.actor_params["params"]["encoder"]),
+                    tree(state.critic_params["params"]["encoder"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+
 def test_shared_encoder_tie_survives_warm_moments(rng):
     """Flipping --share_encoder ON over a resumed UNshared checkpoint
     leaves stale nonzero actor-Adam moments for the encoder subtree;
