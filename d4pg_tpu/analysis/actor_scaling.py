@@ -71,13 +71,118 @@ def measure(n_procs: int, seconds: float, env: str = "point",
     return rate
 
 
+def measure_budget(obs_dim: int = 376, act_dim: int = 17, rows: int = 8,
+                   frames: int = 2000) -> dict:
+    """Per-component cost of one transition frame on the streaming plane:
+    encode (pickle), socket+decode+ingest-callback (loopback TCP through
+    the real ``TransitionReceiver``), and the replay ``service.add`` — the
+    measured budget for where actor fan-out saturates (VERDICT r4 #5).
+    Frame shape = one actor tick of ``rows`` Humanoid-sized transitions."""
+    import threading
+
+    import numpy as np
+
+    from d4pg_tpu.distributed import ReplayService
+    from d4pg_tpu.distributed.transport import (
+        TransitionReceiver,
+        TransitionSender,
+        _encode,
+    )
+    from d4pg_tpu.replay import ReplayBuffer
+    from d4pg_tpu.replay.uniform import TransitionBatch
+
+    rng = np.random.default_rng(0)
+    batch = TransitionBatch(
+        obs=rng.standard_normal((rows, obs_dim)).astype(np.float32),
+        action=rng.uniform(-1, 1, (rows, act_dim)).astype(np.float32),
+        reward=rng.standard_normal(rows).astype(np.float32),
+        next_obs=rng.standard_normal((rows, obs_dim)).astype(np.float32),
+        done=np.zeros(rows, np.float32),
+        discount=np.full(rows, 0.99, np.float32),
+    )
+    out = {"rows_per_frame": rows, "obs_dim": obs_dim}
+
+    payload = _encode("budget", batch, True)
+    out["frame_bytes"] = len(payload)
+    t0 = time.monotonic()
+    for _ in range(frames):
+        _encode("budget", batch, True)
+    out["encode_us_per_frame"] = 1e6 * (time.monotonic() - t0) / frames
+
+    # socket + decode + the PRODUCTION ingest callback (service.add, as
+    # measure() and train.py wire it), through the real receiver thread;
+    # the clock stops only when every row is INSERTED in the buffer (the
+    # service drain thread's work counts — it shares the learner core)
+    service = ReplayService(ReplayBuffer(1_000_000, obs_dim, act_dim))
+    got = threading.Event()
+    n_recv = 0
+
+    def on_batch(b, aid, count):
+        nonlocal n_recv
+        service.add(b, actor_id=aid, count_env_steps=count)
+        n_recv += 1
+        if n_recv >= frames:
+            got.set()
+
+    receiver = TransitionReceiver(on_batch, host="127.0.0.1")
+    sender = TransitionSender("127.0.0.1", receiver.port, actor_id="budget")
+    sender.send(batch)  # connection warmup
+    while n_recv < 1:
+        time.sleep(0.01)
+    n_recv, t0 = 0, time.monotonic()
+    target = len(service.buffer) + frames * rows
+    for _ in range(frames):
+        sender.send(batch)
+    if not got.wait(timeout=120.0):
+        raise RuntimeError(
+            f"ingest stalled: {n_recv}/{frames} frames in 120s")
+    deadline = time.monotonic() + 30.0
+    while len(service.buffer) < target:  # drain-thread completion
+        if time.monotonic() > deadline:
+            raise RuntimeError("replay drain stalled")
+        time.sleep(0.001)
+    out["socket_ingest_us_per_frame"] = 1e6 * (time.monotonic() - t0) / frames
+    sender.close()
+    receiver.close()
+    service.close()
+
+    # the raw locked buffer insert alone (the drain thread's inner cost)
+    buf = ReplayBuffer(1_000_000, obs_dim, act_dim)
+    buf.add(batch)
+    t0 = time.monotonic()
+    for _ in range(frames):
+        buf.add(batch)
+    out["buffer_insert_us_per_frame"] = 1e6 * (time.monotonic() - t0) / frames
+
+    total_us = (out["encode_us_per_frame"]
+                + out["socket_ingest_us_per_frame"])
+    # encode happens actor-side (parallel across procs); the learner-side
+    # serial section is socket+decode+service.add+insert — the measured
+    # wall above — so IT sets the plane ceiling
+    out["plane_ceiling_env_steps_per_sec"] = (
+        rows * 1e6 / out["socket_ingest_us_per_frame"])
+    out["single_actor_env_steps_per_sec"] = rows * 1e6 / total_us
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="d4pg_tpu.analysis.actor_scaling")
     ap.add_argument("--procs", type=int, nargs="+", default=[1, 2, 4])
     ap.add_argument("--seconds", type=float, default=10.0)
-    ap.add_argument("--env", default="point")
+    ap.add_argument("--env", default="point",
+                    help="'point-slow:<ms>' emulates a physics-bound env "
+                         "so the plane, not the host core, is measured")
     ap.add_argument("--num_envs", type=int, default=8)
+    ap.add_argument("--budget", action="store_true",
+                    help="measure the per-component frame budget instead "
+                         "of the scaling table")
     ns = ap.parse_args(argv)
+    if ns.budget:
+        budget = measure_budget()
+        for key, val in budget.items():
+            sval = f"{val:,.1f}" if isinstance(val, float) else str(val)
+            print(f"{key:>34}: {sval}")
+        return
     print(f"{'procs':>6} {'env-steps/sec':>14}")
     base = None
     for n in ns.procs:

@@ -18,7 +18,7 @@ from d4pg_tpu.envs.wrappers import (
 from d4pg_tpu.envs.her import her_relabel
 from d4pg_tpu.envs.vector import EnvPool
 from d4pg_tpu.envs.presets import EnvPreset, PRESETS, get_preset
-from d4pg_tpu.envs.fake import FakeGoalEnv, PixelPointEnv, PointMassEnv
+from d4pg_tpu.envs.fake import FakeGoalEnv, PixelPointEnv, PointMassEnv, SlowEnv
 
 __all__ = [
     "GoalObs",
@@ -33,4 +33,5 @@ __all__ = [
     "FakeGoalEnv",
     "PixelPointEnv",
     "PointMassEnv",
+    "SlowEnv",
 ]

@@ -22,6 +22,40 @@ class _Box:
         self.shape = shape
 
 
+class SlowEnv:
+    """Wrap an env with a fixed wall-clock cost per ``step()``.
+
+    Emulates a physics-bound env (MuJoCo steps cost ~1-40 ms of host CPU)
+    without needing MuJoCo: the sleep holds the actor's *rate* at the
+    wrapped cost while leaving its CPU demand near zero, so N throttled
+    actor processes on one machine measure the TRANSPORT/INGEST plane's
+    scaling (analysis/actor_scaling.py), not host-core contention — the
+    regime the reference's N-worker fan-out (``main.py:399-405``) actually
+    runs in, where workers are env-bound and the shared plane is the
+    question."""
+
+    def __init__(self, env, step_seconds: float):
+        self._env = env
+        self._step_seconds = step_seconds
+        self.action_space = env.action_space
+        self.observation_space = env.observation_space
+
+    def reset(self, seed=None, **kw):
+        return self._env.reset(seed=seed, **kw)
+
+    def step(self, action):
+        import time
+
+        time.sleep(self._step_seconds)
+        return self._env.step(action)
+
+    def close(self):
+        self._env.close()
+
+    def __getattr__(self, name):
+        return getattr(self._env, name)
+
+
 class PointMassEnv:
     """2-D point mass: action = acceleration, reward = -|pos| - 0.01|a|^2."""
 

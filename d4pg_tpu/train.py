@@ -64,7 +64,8 @@ from d4pg_tpu.replay.uniform import TransitionBatch
 def make_env_fn(cfg: ExperimentConfig, seed: int):
     """Build one env instance; gymnasium by id, with fake-env fallbacks for
     ids 'point' and 'fake-goal' (tests/smoke, SURVEY.md §4)."""
-    if cfg.env in ("point", "fake-goal") and cfg.frame_stack > 1:
+    if ((cfg.env in ("point", "fake-goal")
+         or cfg.env.startswith("point-slow:")) and cfg.frame_stack > 1):
         # fail loudly rather than silently training on unstacked frames —
         # the exact POMDP failure the flag exists to fix
         raise ValueError(
@@ -72,6 +73,15 @@ def make_env_fn(cfg: ExperimentConfig, seed: int):
             f"{cfg.env!r} is state-observation")
     if cfg.env == "point":
         return lambda: PointMassEnv(horizon=cfg.max_steps, seed=seed)
+    if cfg.env.startswith("point-slow:"):
+        # 'point-slow:<ms>' — point mass with a fixed <ms> wall cost per
+        # step, emulating a physics-bound env for transport-plane scaling
+        # measurements (analysis/actor_scaling.py) without MuJoCo
+        from d4pg_tpu.envs.fake import SlowEnv
+
+        step_ms = float(cfg.env.split(":", 1)[1])
+        return lambda: SlowEnv(PointMassEnv(horizon=cfg.max_steps, seed=seed),
+                               step_ms / 1e3)
     if cfg.env == "fake-goal":
         return lambda: FakeGoalEnv(horizon=cfg.max_steps, seed=seed)
     def stack(make_pixel_env):

@@ -12,8 +12,22 @@
 # Stops when the training process exits 0 (run complete) or the
 # restart budget is exhausted (persistently failing config).
 set -u
+if [ $# -lt 3 ]; then
+  echo "usage: run_supervised.sh <max_restarts> <logfile> -- <train args...>" >&2
+  exit 2
+fi
 MAX=$1; LOG=$2; shift 2
 [ "$1" = "--" ] && shift
+case " $* " in
+  *" --resume 1 "*|*" --resume=1 "*|*" --resume 1"|*" --resume=1") ;;
+  *)
+    # without --resume 1 every restart would silently reinitialize the
+    # run and the log would splice unrelated curves — the one invariant
+    # this supervisor exists to uphold ('--resume 0' is just as wrong
+    # as omitting it)
+    echo "run_supervised.sh: train args must include '--resume 1'" >&2
+    exit 2 ;;
+esac
 n=0
 while true; do
   python -m d4pg_tpu.train "$@" >>"$LOG" 2>&1
