@@ -165,9 +165,15 @@ def bench_fused(k: int = 40, capacity: int = 200_000,
 
     Returns ``repeats`` independent timed-window rates (VERDICT r4 #3: a
     single capture moved 2.5x run-to-run with tunnel health; the headline
-    must carry its own spread)."""
+    must carry its own spread) plus the steady-state sentinel counts: the
+    timed windows run under ``RecompileSentinel`` (which ASSERTS zero XLA
+    compilations after the warmup dispatch — a silent recompile would turn
+    the headline number into compilation-time measurement) and
+    ``TransferSentinel`` (explicit host<->device transfers; the fused
+    path's claim is that steady state makes none)."""
     import jax
 
+    from d4pg_tpu.io.profiling import RecompileSentinel, TransferSentinel
     from d4pg_tpu.learner import init_state
     from d4pg_tpu.learner.fused import make_fused_chunk
     from d4pg_tpu.replay.fused_buffer import FusedDeviceReplay
@@ -184,14 +190,16 @@ def bench_fused(k: int = 40, capacity: int = 200_000,
     jax.block_until_ready(m["critic_loss"])
     n_dispatch = max(1, steps // k)
     rates = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        for _ in range(n_dispatch):
-            state, buffer.trees, m = fn(state, buffer.trees, buffer.storage,
-                                        buffer.size)
-        jax.block_until_ready(m["critic_loss"])
-        rates.append(n_dispatch * k / (time.perf_counter() - t0))
-    return rates
+    with RecompileSentinel() as recompiles, TransferSentinel() as transfers:
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            for _ in range(n_dispatch):
+                state, buffer.trees, m = fn(state, buffer.trees,
+                                            buffer.storage, buffer.size)
+            jax.block_until_ready(m["critic_loss"])
+            rates.append(n_dispatch * k / (time.perf_counter() - t0))
+    recompiles.assert_clean("bench_fused steady-state loop")
+    return rates, recompiles.compilations, transfers.total
 
 
 def bench_projection_variants(k: int = 40, steps: int = 1600) -> dict | None:
@@ -465,7 +473,7 @@ def main():
 
     backend = ensure_backend(timeout=180.0)
     device_only = bench_tpu()
-    fused_rates = bench_fused()
+    fused_rates, fused_recompiles, fused_transfers = bench_fused()
     fused = float(np.median(fused_rates))
     host_pipeline = bench_end_to_end()
     baseline = bench_reference_torch_cpu() or RECORDED_BASELINE_SPS
@@ -483,6 +491,12 @@ def main():
         "max": round(max(fused_rates), 2),
         "repeats": [round(r, 2) for r in fused_rates],
         "device_only": round(device_only, 2),
+        # sentinel counts over ALL timed fused windows (repeats x
+        # n_dispatch dispatches): both must be 0, and bench_fused already
+        # asserts the recompile count — a nonzero here means the rates
+        # above timed the compiler/PCIe, not the learner
+        "steady_state_recompiles": fused_recompiles,
+        "steady_state_explicit_transfers": fused_transfers,
         "host_pipeline_e2e": round(host_pipeline, 2),
         "baseline_torch_cpu": round(baseline, 2),
         # host-projection-bound ceiling of the reference on ANY GPU —

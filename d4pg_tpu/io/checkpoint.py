@@ -78,7 +78,10 @@ class CheckpointManager:
         # then a typed restore against the template rebuilds the real
         # containers (optax NamedTuple states etc.) — a raw-only restore
         # would hand back plain dicts that break continued training.
-        raw = self._mgr.restore(step)
+        # A bare ``restore(step)`` fails on a freshly-constructed manager
+        # (no handler registered for the "default" item); StandardRestore
+        # without a target does the schema-free read.
+        raw = self._mgr.restore(step, args=ocp.args.StandardRestore())
         target = {"state": self._to_host(template), "extra": raw["extra"]}
         restored = self._mgr.restore(step, args=ocp.args.StandardRestore(target))
         d = restored["state"]

@@ -1,10 +1,17 @@
-"""Profiling: XLA trace capture + step-rate tracking.
+"""Profiling: XLA trace capture, step-rate tracking, perf sentinels.
 
 SURVEY.md §5: the reference's only timing is wall-clock deltas into a dict
 that is never persisted (``main.py:250, 359``). Here: ``jax.profiler``
 traces on demand (viewable in TensorBoard/Perfetto) and an EWMA'd
 grad-steps/sec meter — the north-star metric (BASELINE.md) — cheap enough
 to leave on.
+
+The two sentinels are the runtime complement of the static ``jaxlint``
+pass (``d4pg_tpu/lint``): the linter catches hazards it can see in the
+AST; the sentinels catch what it can't — a hot loop that recompiles in
+steady state (``RecompileSentinel``, wired into ``bench.py`` and the
+learner tests) or round-trips data between host and device per step
+(``TransferSentinel``).
 """
 
 from __future__ import annotations
@@ -53,3 +60,114 @@ class StepTimer:
                 else self._alpha * self.rate + (1 - self._alpha) * inst
             )
         return self.rate
+
+
+class RecompileError(AssertionError):
+    """A region that must be compile-free triggered XLA compilation."""
+
+
+class RecompileSentinel:
+    """Counts XLA backend compilations inside the bracketed region.
+
+    Zero steady-state recompilation is a core throughput invariant of this
+    stack (every surprise compile stalls the learner for seconds): after
+    warmup, wrap the hot loop and call :meth:`assert_clean`.
+
+    Detection uses ``jax.monitoring``'s event stream — every backend
+    compile records a ``/jax/core/compile/backend_compile_duration``
+    event, and cache hits record nothing — so ANY jitted callable
+    (including scans/shard_maps nested in it) is observed without
+    instrumenting the callable itself.
+
+        with RecompileSentinel() as sentinel:
+            for _ in range(n):
+                state, metrics = update(state, batch)
+        sentinel.assert_clean()
+    """
+
+    _EVENT = "/jax/core/compile/backend_compile_duration"
+
+    def __init__(self):
+        self.compilations = 0
+        self._active = False
+
+    def _on_event(self, event: str, duration: float, **_kw) -> None:
+        if self._active and event == self._EVENT:
+            self.compilations += 1
+
+    def __enter__(self) -> "RecompileSentinel":
+        from jax._src import monitoring
+
+        monitoring.register_event_duration_secs_listener(self._on_event)
+        self._active = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._active = False
+        from jax._src import monitoring
+
+        try:
+            monitoring._unregister_event_duration_listener_by_callback(
+                self._on_event)
+        except (AttributeError, ValueError):
+            pass  # older jax: listener stays registered but inert (_active)
+
+    def assert_clean(self, what: str = "steady-state region") -> None:
+        if self.compilations:
+            raise RecompileError(
+                f"{what} triggered {self.compilations} XLA compilation(s) "
+                "after warmup — a static-shape or weak-type mismatch is "
+                "defeating the jit cache")
+
+
+class TransferSentinel:
+    """Counts explicit host<->device transfers in the bracketed region.
+
+    Patches ``jax.device_put`` / ``jax.device_get`` for the duration of
+    the context and tallies calls (``h2d`` / ``d2h``). Implicit transfers
+    (``np.asarray`` on a device array, scalar coercion) bypass those entry
+    points; pass ``guard="disallow"`` to make jax raise on them instead —
+    note the guard is inert on the CPU backend, where host and device
+    memory are one and the same.
+
+        with TransferSentinel() as t:
+            run_fused_chunk()
+        assert t.total == 0
+    """
+
+    def __init__(self, guard: str | None = None):
+        self.h2d = 0
+        self.d2h = 0
+        self._guard = guard
+        self._stack: contextlib.ExitStack | None = None
+
+    @property
+    def total(self) -> int:
+        return self.h2d + self.d2h
+
+    def __enter__(self) -> "TransferSentinel":
+        import jax
+
+        self._orig_put, self._orig_get = jax.device_put, jax.device_get
+
+        def counted_put(*a, **kw):
+            self.h2d += 1
+            return self._orig_put(*a, **kw)
+
+        def counted_get(*a, **kw):
+            self.d2h += 1
+            return self._orig_get(*a, **kw)
+
+        jax.device_put, jax.device_get = counted_put, counted_get
+        self._stack = contextlib.ExitStack()
+        if self._guard:
+            self._stack.enter_context(jax.transfer_guard(self._guard))
+        return self
+
+    def __exit__(self, *exc) -> None:
+        import jax
+
+        jax.device_put, jax.device_get = self._orig_put, self._orig_get
+        if self._stack is not None:
+            self._stack.close()
+            self._stack = None

@@ -151,7 +151,7 @@ def make_sharded_fused_chunk(
     uniform: ``fn(state, storage, size) -> (state, metrics)``. ``size``
     is the per-shard live-row count [n_shards].
     """
-    from jax import shard_map
+    from d4pg_tpu.parallel.compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from d4pg_tpu.parallel.data_parallel import check_mesh_compatible

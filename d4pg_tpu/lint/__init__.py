@@ -1,0 +1,22 @@
+"""jaxlint — JAX/TPU-aware static analysis for this codebase.
+
+Run as ``python -m d4pg_tpu.lint [paths]``; library API:
+
+    from d4pg_tpu.lint import lint_paths, lint_source, RULES
+
+The hazards it targets (PRNG key reuse, host syncs under jit, recompile
+traps, donation misuse, tracer leaks) are exactly the ones that silently
+erode the learner's on-device throughput story — see the "Static analysis
+& perf sentinels" section of docs/architecture.md. The runtime complements
+(RecompileSentinel / TransferSentinel) live in ``d4pg_tpu.io.profiling``.
+
+Pure stdlib (ast) — importing this package must never initialize JAX, so
+the linter stays runnable in CI images without an accelerator.
+"""
+
+from d4pg_tpu.lint.engine import LintResult, lint_paths, lint_source
+from d4pg_tpu.lint.findings import Finding, Suppressions
+from d4pg_tpu.lint.rules import RULES
+
+__all__ = ["Finding", "LintResult", "RULES", "Suppressions", "lint_paths",
+           "lint_source"]

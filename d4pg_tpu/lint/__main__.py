@@ -1,0 +1,62 @@
+"""CLI: ``python -m d4pg_tpu.lint [paths] [--rules a,b] [--list-rules]``.
+
+Exit code 0 when every finding is suppressed (or none exist), 1 otherwise.
+With no paths, lints the ``d4pg_tpu`` package itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from d4pg_tpu.lint.engine import lint_paths
+from d4pg_tpu.lint.rules import RULES
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m d4pg_tpu.lint",
+        description="JAX/TPU-aware static analysis for the d4pg_tpu stack")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories (default: the d4pg_tpu "
+                             "package)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids to run (default all)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also print suppressed findings")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in RULES.values():
+            print(f"{rule.id:20s} {rule.summary}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+
+    paths = args.paths or [os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))]
+    result = lint_paths(paths, rules=rules)
+
+    for f in result.findings:
+        print(f.format())
+    if args.show_suppressed:
+        for f in result.suppressed:
+            print(f.format())
+    for e in result.errors:
+        print(e, file=sys.stderr)
+    n, s = len(result.findings), len(result.suppressed)
+    print(f"jaxlint: {n} finding(s), {s} suppressed", file=sys.stderr)
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,244 @@
+"""Module-level analysis context shared by all jaxlint rules.
+
+The central question every rule asks is "does this code run under a JAX
+trace?" — ``.item()`` on the host is fine, inside ``jit`` it is a silent
+device sync (or a concretization error). ``ModuleContext`` answers it
+statically and conservatively:
+
+- a function is *traced* when it is decorated with a tracing transform
+  (``jit``/``pmap``/``vmap``/``grad``/``checkpoint``/``custom_vjp``, bare
+  or dotted or under ``functools.partial``),
+- or its name/lambda is passed to a trace-inducing call
+  (``jax.jit(f)``, ``lax.scan(body, ...)``, ``shard_map(f, ...)``,
+  ``pl.pallas_call(kernel, ...)`` …),
+- or it is lexically nested inside a traced function,
+- or it is CALLED from a traced function in the same module (transitive:
+  ``jax.jit(lambda s, b: update_step(cfg, s, b))`` taints ``update_step``
+  and everything update_step calls). A sync point reached from a traced
+  caller is a bug no matter how many plain-function hops sit in between.
+
+The context also records *jit bindings* — ``g = jax.jit(f, donate_argnums=…,
+static_argnums=…)`` — so call-site rules (donation, static-arg hazards)
+can reason about ``g(...)`` later in the same module.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# Transforms that trace their operand eagerly or at call time.
+TRACE_WRAPPERS = {
+    "jit", "pmap", "vmap", "grad", "value_and_grad", "checkpoint", "remat",
+    "custom_jvp", "custom_vjp", "shard_map", "named_call", "pallas_call",
+}
+# lax control-flow primitives whose function-valued args are traced, plus
+# custom-derivative registration (fn.defvjp(fwd, bwd) traces both rules).
+TRACE_HOFS = {
+    "scan", "fori_loop", "while_loop", "cond", "switch", "map",
+    "associative_scan", "defvjp", "defjvp", "defjvps",
+}
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'jax.lax.scan' for an Attribute chain, 'jit' for a Name, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_part(dotted: str | None) -> str | None:
+    return dotted.rsplit(".", 1)[-1] if dotted else None
+
+
+def _unwrap_partial(call: ast.Call) -> ast.expr | None:
+    """partial(jit, ...) / functools.partial(jax.jit, ...) -> the jit expr."""
+    if last_part(dotted_name(call.func)) == "partial" and call.args:
+        return call.args[0]
+    return None
+
+
+def is_trace_wrapper_expr(node: ast.expr) -> bool:
+    """True for an expression denoting a tracing transform: ``jax.jit``,
+    ``jit``, ``partial(jax.jit, static_argnums=0)`` …"""
+    if isinstance(node, ast.Call):
+        inner = _unwrap_partial(node)
+        if inner is not None:
+            return is_trace_wrapper_expr(inner)
+        # jax.jit(...) as a decorator factory: @jax.jit(donate_argnums=0)
+        return is_trace_wrapper_expr(node.func)
+    return last_part(dotted_name(node)) in TRACE_WRAPPERS
+
+
+def call_kind(call: ast.Call) -> str | None:
+    """'wrapper' for jit/pmap/… calls, 'hof' for lax.scan-style calls."""
+    target = _unwrap_partial(call)
+    name = last_part(dotted_name(target if target is not None else call.func))
+    if name in TRACE_WRAPPERS:
+        return "wrapper"
+    if name in TRACE_HOFS:
+        return "hof"
+    return None
+
+
+@dataclass
+class JitBinding:
+    """``name = jax.jit(fn, donate_argnums=…, static_argnums=…)``."""
+
+    name: str
+    line: int
+    donate_argnums: tuple[int, ...] = ()
+    static_argnums: tuple[int, ...] = ()
+
+
+@dataclass
+class ModuleContext:
+    path: str
+    source: str
+    tree: ast.Module
+    traced: set[ast.AST] = field(default_factory=set)
+    # function-name -> binding, module-scope only (conservative)
+    jit_bindings: dict[str, JitBinding] = field(default_factory=dict)
+    # every FunctionDef/Lambda -> its immediate parent function (or None)
+    parents: dict[ast.AST, ast.AST | None] = field(default_factory=dict)
+
+    def is_traced(self, func: ast.AST) -> bool:
+        return func in self.traced
+
+
+def _int_tuple(node: ast.expr | None) -> tuple[int, ...]:
+    """Literal int / tuple-or-list of ints -> tuple; anything else -> ()."""
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return ()
+        return tuple(out)
+    return ()
+
+
+def build_context(path: str, source: str) -> ModuleContext:
+    tree = ast.parse(source, filename=path)
+    ctx = ModuleContext(path=path, source=source, tree=tree)
+
+    # ---- index functions: defs AND `name = lambda`/`name = def` aliases,
+    # keyed by (scope id, name); record lexical scope chains ----------------
+    defs_by_name: dict[tuple[int, str], ast.AST] = {}
+    scope_chain: dict[ast.AST, tuple[int, ...]] = {}  # innermost first
+
+    def index(node: ast.AST, parent_func: ast.AST | None,
+              chain: tuple[int, ...]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, FunctionNode):
+                ctx.parents[child] = parent_func
+                scope_chain[child] = chain
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    defs_by_name[(chain[0], child.name)] = child
+                index(child, child, (id(child), *chain))
+            else:
+                if (isinstance(child, ast.Assign) and len(child.targets) == 1
+                        and isinstance(child.targets[0], ast.Name)
+                        and isinstance(child.value, ast.Lambda)):
+                    defs_by_name[(chain[0], child.targets[0].id)] = child.value
+                index(child, parent_func, chain)
+
+    index(tree, None, (id(tree),))
+
+    def resolve(chain: tuple[int, ...], expr: ast.expr) -> ast.AST | None:
+        if isinstance(expr, ast.Lambda):
+            return expr
+        if isinstance(expr, ast.Name):
+            for scope in chain:
+                hit = defs_by_name.get((scope, expr.id))
+                if hit is not None:
+                    return hit
+        return None
+
+    # ---- find traced roots ----------------------------------------------
+    roots: set[ast.AST] = set()
+
+    def scan_for_roots(node: ast.AST, chain: tuple[int, ...]):
+        for child in ast.iter_child_nodes(node):
+            child_chain = ((id(child), *chain)
+                           if isinstance(child, FunctionNode) else chain)
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(is_trace_wrapper_expr(d) for d in child.decorator_list):
+                    roots.add(child)
+            if isinstance(child, ast.Call):
+                kind = call_kind(child)
+                if kind == "wrapper" and child.args:
+                    f = resolve(chain, child.args[0])
+                    if f is not None:
+                        roots.add(f)
+                elif kind == "hof":
+                    # every function-valued positional arg is a traced body
+                    for a in child.args:
+                        f = resolve(chain, a)
+                        if f is not None:
+                            roots.add(f)
+            scan_for_roots(child, child_chain)
+
+    scan_for_roots(tree, (id(tree),))
+
+    # ---- record module-scope jit bindings -------------------------------
+    for stmt in tree.body:
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1):
+            continue
+        target = stmt.targets[0]
+        if not isinstance(target, ast.Name) or not isinstance(stmt.value, ast.Call):
+            continue
+        if call_kind(stmt.value) != "wrapper":
+            continue
+        kwargs = {k.arg: k.value for k in stmt.value.keywords if k.arg}
+        ctx.jit_bindings[target.id] = JitBinding(
+            name=target.id, line=stmt.lineno,
+            donate_argnums=_int_tuple(kwargs.get("donate_argnums")),
+            static_argnums=_int_tuple(kwargs.get("static_argnums")),
+        )
+
+    # ---- same-module call graph for transitive taint --------------------
+    # F -> {G}: F's body mentions G by a name that resolves through F's
+    # lexical scope chain (a call or a bare reference — passing update_step
+    # into a helper taints it just as calling it does)
+    calls: dict[ast.AST, set[ast.AST]] = {}
+    for func, chain in scope_chain.items():
+        out: set[ast.AST] = set()
+        own_chain = (id(func), *chain)
+        for node in ast.walk(func):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                target = resolve(own_chain, node)
+                if target is not None and target is not func:
+                    out.add(target)
+        calls[func] = out
+
+    # ---- propagate: lexical nesting + call edges, to fixpoint -----------
+    def mark(node: ast.AST):
+        stack = [node]
+        while stack:
+            cur = stack.pop()
+            if cur in ctx.traced:
+                continue
+            ctx.traced.add(cur)
+            for child in ast.walk(cur):
+                if isinstance(child, FunctionNode) and child not in ctx.traced:
+                    stack.append(child)
+            for callee in calls.get(cur, ()):
+                if callee not in ctx.traced:
+                    stack.append(callee)
+
+    for root in roots:
+        mark(root)
+    return ctx

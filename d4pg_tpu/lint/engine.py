@@ -1,0 +1,76 @@
+"""jaxlint driver: file discovery, rule execution, suppression filtering."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from d4pg_tpu.lint.context import build_context
+from d4pg_tpu.lint.findings import Finding, Suppressions
+from d4pg_tpu.lint.rules import RULES
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding] = field(default_factory=list)  # unsuppressed
+    suppressed: list[Finding] = field(default_factory=list)
+    errors: list[str] = field(default_factory=list)  # unparseable files
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.errors
+
+
+def iter_py_files(paths: list[str]):
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+        else:
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in {"__pycache__", ".git", "_native"})
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def lint_source(source: str, path: str = "<string>",
+                rules: list[str] | None = None) -> LintResult:
+    """Lint one source string; the unit the fixture tests drive."""
+    result = LintResult()
+    try:
+        ctx = build_context(path, source)
+    except SyntaxError as e:
+        result.errors.append(f"{path}: syntax error: {e}")
+        return result
+    sup = Suppressions.parse(source)
+    active = [RULES[r] for r in rules] if rules else list(RULES.values())
+    collected: list[Finding] = []
+    for rule in active:
+        collected.extend(rule.check(ctx))
+    for f in sorted(collected, key=lambda f: (f.line, f.col, f.rule)):
+        if sup.covers(f):
+            f.suppressed = True
+            result.suppressed.append(f)
+        else:
+            result.findings.append(f)
+    return result
+
+
+def lint_paths(paths: list[str],
+               rules: list[str] | None = None) -> LintResult:
+    result = LintResult()
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            result.errors.append(f"{path}: {e}")
+            continue
+        one = lint_source(source, path, rules=rules)
+        result.findings.extend(one.findings)
+        result.suppressed.extend(one.suppressed)
+        result.errors.extend(one.errors)
+    return result

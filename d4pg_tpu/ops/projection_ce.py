@@ -110,8 +110,10 @@ def _fwd(support, target_probs, rewards, discounts, pred_probs, interpret):
     a = support.n_atoms
     p, r, d, q, n, total = _pad_operands(
         support, target_probs, rewards, discounts, pred_probs)
+    # `support` is a nondiff_argnums operand: a plain Python NamedTuple at
+    # trace time, so float() here is static config math, not a device sync
     kernel = functools.partial(
-        _fwd_kernel, v_min=float(support.v_min), v_max=float(support.v_max),
+        _fwd_kernel, v_min=float(support.v_min), v_max=float(support.v_max),  # jaxlint: disable=host-sync-in-jit
         n_atoms=a)
     td = pl.pallas_call(
         kernel,
@@ -135,8 +137,9 @@ def _bwd(support, interpret, res, g):
     p, r, d, q, n, total = _pad_operands(
         support, target_probs, rewards, discounts, pred_probs)
     gpad = jnp.pad(g.astype(jnp.float32), (0, total - n))[:, None]
+    # `support` is static at trace time (see _fwd): host config math
     kernel = functools.partial(
-        _bwd_kernel, v_min=float(support.v_min), v_max=float(support.v_max),
+        _bwd_kernel, v_min=float(support.v_min), v_max=float(support.v_max),  # jaxlint: disable=host-sync-in-jit
         n_atoms=a)
     dq = pl.pallas_call(
         kernel,

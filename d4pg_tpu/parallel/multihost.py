@@ -133,4 +133,6 @@ def replicate_state_global(init_fn, mesh: Mesh):
     materializes identical replicas everywhere.
     """
     repl = NamedSharding(mesh, P())
-    return jax.jit(init_fn, out_shardings=repl)()
+    # one-shot by design: jit is the only mechanism that can materialize
+    # state on other processes' devices, and this runs once at startup
+    return jax.jit(init_fn, out_shardings=repl)()  # jaxlint: disable=recompile-hazard

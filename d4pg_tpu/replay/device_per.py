@@ -59,7 +59,9 @@ class PerTrees(NamedTuple):
 
 
 def _levels(capacity: int) -> int:
-    return int(math.log2(capacity))
+    # capacity comes from Array.shape — a static Python int at trace time,
+    # so this is host shape math (it sizes the descent loop), not a sync
+    return int(math.log2(capacity))  # jaxlint: disable=host-sync-in-jit
 
 
 def init(capacity: int) -> PerTrees:

@@ -49,9 +49,17 @@ def load_native(autobuild: bool = True):
     if not os.path.exists(_LIB_PATH):
         return None
     try:
-        lib = ctypes.CDLL(_LIB_PATH)
-    except OSError:
+        lib = _bind(ctypes.CDLL(_LIB_PATH))
+    except (OSError, AttributeError):
+        # OSError: wrong platform/ABI for the checked-in .so;
+        # AttributeError: a stale .so missing expected symbols. Either way
+        # the numpy backend takes over — rebuild with `make -C native`.
         return None
+    _lib = lib
+    return _lib
+
+
+def _bind(lib):
     lib.pt_new.restype = ctypes.c_void_p
     lib.pt_new.argtypes = [ctypes.c_int64]
     lib.pt_free.argtypes = [ctypes.c_void_p]
@@ -73,8 +81,7 @@ def load_native(autobuild: bool = True):
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_double),
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
     ]
-    _lib = lib
-    return _lib
+    return lib
 
 
 def _i64(a: np.ndarray):

@@ -160,8 +160,11 @@ class ShardedFusedReplay:
             # host-local device_put cannot address other hosts' devices;
             # construct inside jit with sharded outputs (SPMD — every
             # process traces the same zeros)
-            self.storage = jax.jit(_zero_storage, out_shardings=shard)()
-            self.trees = (jax.jit(_zero_trees, out_shardings=shard)()
+            # one-shot by design (runs once in __init__): jit-with-
+            # out_shardings is the only way to materialize the buffer on
+            # every process's devices
+            self.storage = jax.jit(_zero_storage, out_shardings=shard)()  # jaxlint: disable=recompile-hazard
+            self.trees = (jax.jit(_zero_trees, out_shardings=shard)()  # jaxlint: disable=recompile-hazard
                           if prioritized else None)
         else:
             self.storage = jax.device_put(_zero_storage(), shard)
@@ -233,7 +236,7 @@ class ShardedFusedReplay:
         (``mode='drop'``) and the tree write (``set_leaves``'s pad-drop
         convention) discard."""
         import jax
-        from jax import shard_map
+        from d4pg_tpu.parallel.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from d4pg_tpu.parallel.mesh import DATA_AXIS

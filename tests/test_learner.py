@@ -169,6 +169,26 @@ def test_multi_update_equals_sequential(rng):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_update_loop_is_steady_state(rng):
+    """The learner hot path must hit the jit cache after warmup: repeated
+    update calls (fresh batch values, same shapes/dtypes) may not trigger a
+    single XLA compilation. Guards the invariant bench.py's headline rate
+    depends on — a weak-type or shape instability here would silently turn
+    throughput numbers into compile-time measurements."""
+    from d4pg_tpu.io.profiling import RecompileSentinel
+
+    config = _config()
+    state = init_state(config, jax.random.key(0))
+    update = make_update(config, donate=False)
+    state, _ = update(state, _batch(rng), jnp.ones((B,), jnp.float32))  # warmup
+    with RecompileSentinel() as sentinel:
+        for i in range(3):
+            batch = _batch(np.random.default_rng(i))
+            state, metrics = update(state, batch, jnp.ones((B,), jnp.float32))
+    jax.block_until_ready(metrics["critic_loss"])
+    sentinel.assert_clean("learner update loop")
+
+
 def test_act_shapes_and_bounds(rng):
     config = _config()
     state = init_state(config, jax.random.key(4))

@@ -1,0 +1,310 @@
+"""jaxlint rule-family fixtures: each rule must fire on a known-bad snippet
+and stay silent on the known-good variant, plus suppression/CLI plumbing.
+
+The fixtures deliberately contain the hazards the rules hunt — none of
+this code is ever executed, only parsed.
+"""
+
+import textwrap
+
+import pytest
+
+from d4pg_tpu.lint import RULES, lint_source
+from d4pg_tpu.lint.__main__ import main as lint_main
+
+
+def findings(src, rule=None):
+    res = lint_source(textwrap.dedent(src), "fixture.py")
+    assert not res.errors, res.errors
+    out = res.findings
+    return [f for f in out if f.rule == rule] if rule else out
+
+
+# ---------------------------------------------------------------- R1 ------
+
+def test_prng_key_reuse_fires():
+    out = findings("""
+        import jax
+
+        def sample(key):
+            a = jax.random.normal(key, (3,))
+            b = jax.random.uniform(key, (3,))
+            return a + b
+        """, "prng-key-reuse")
+    assert len(out) == 1
+    assert "'key'" in out[0].message and out[0].line == 6
+
+
+def test_prng_key_reuse_across_loop_iterations():
+    # consumed every iteration, never re-split: same randomness each time
+    out = findings("""
+        import jax
+
+        def rollout(key, xs):
+            outs = []
+            for x in xs:
+                outs.append(x + jax.random.normal(key))
+            return outs
+        """, "prng-key-reuse")
+    assert len(out) == 1
+
+
+def test_prng_key_clean_patterns():
+    out = findings("""
+        import jax
+
+        def good(key):
+            k1, k2 = jax.random.split(key)
+            a = jax.random.normal(k1)
+            b = jax.random.uniform(k2)
+            return a + b
+
+        def folded(key, n):
+            return [jax.random.normal(jax.random.fold_in(key, i))
+                    for i in range(n)]
+
+        def loop_rebind(key, xs):
+            for x in xs:
+                key, sub = jax.random.split(key)
+                x = x + jax.random.normal(sub)
+            return key
+
+        def branches(key, flag):
+            if flag:
+                return jax.random.normal(key)
+            else:
+                return jax.random.uniform(key)
+
+        def numpy_not_keys(mu, sigma):
+            import numpy as np
+            a = np.random.normal(mu, sigma)
+            b = np.random.normal(mu, sigma)
+            return a + b
+        """, "prng-key-reuse")
+    assert out == []
+
+
+# ---------------------------------------------------------------- R2 ------
+
+def test_host_sync_fires_in_jitted_fn():
+    out = findings("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            v = float(x.sum())
+            y = np.asarray(x)
+            x.block_until_ready()
+            return v + x.item()
+        """, "host-sync-in-jit")
+    assert len(out) == 4
+
+
+def test_host_sync_fires_in_scan_body():
+    out = findings("""
+        import jax.lax as lax
+
+        def outer(xs):
+            def body(c, x):
+                return c + int(x), x
+            return lax.scan(body, 0.0, xs)
+        """, "host-sync-in-jit")
+    assert len(out) == 1
+
+
+def test_host_sync_clean_outside_trace():
+    # identical syncs in plain host code are legitimate
+    out = findings("""
+        import numpy as np
+
+        def log_metrics(metrics):
+            return {k: float(v) for k, v in metrics.items()}
+
+        def to_host(x):
+            return np.asarray(x)
+        """, "host-sync-in-jit")
+    assert out == []
+
+
+# ---------------------------------------------------------------- R3 ------
+
+def test_recompile_jit_in_loop_and_immediate_call():
+    out = findings("""
+        import jax
+
+        def train(xs):
+            for x in xs:
+                y = jax.jit(lambda z: z + 1)(x)
+            return y
+        """, "recompile-hazard")
+    # both hazards on one line: jit-in-loop AND jit(f)(x)
+    assert len(out) == 2
+
+
+def test_recompile_loop_var_as_static_arg():
+    out = findings("""
+        import jax
+
+        def f(x, n):
+            return x * n
+
+        g = jax.jit(f, static_argnums=(1,))
+
+        def run(x):
+            for n in range(8):
+                x = g(x, n)
+            return x
+        """, "recompile-hazard")
+    assert len(out) == 1 and "loop variable 'n'" in out[0].message
+
+
+def test_recompile_clean_hoisted_jit():
+    out = findings("""
+        import jax
+
+        g = jax.jit(lambda z: z + 1)
+
+        def train(xs):
+            for x in xs:
+                y = g(x)
+            return y
+        """, "recompile-hazard")
+    assert out == []
+
+
+# ---------------------------------------------------------------- R4 ------
+
+def test_use_after_donation_fires():
+    out = findings("""
+        import jax
+
+        g = jax.jit(lambda s: s, donate_argnums=(0,))
+
+        def run(state):
+            out = g(state)
+            print(state)
+            return out
+        """, "use-after-donation")
+    assert len(out) == 1 and "'state'" in out[0].message
+
+
+def test_donation_clean_on_rebind():
+    out = findings("""
+        import jax
+
+        g = jax.jit(lambda s: s, donate_argnums=(0,))
+
+        def run(state):
+            for _ in range(4):
+                state = g(state)
+            return state
+        """, "use-after-donation")
+    assert out == []
+
+
+# ---------------------------------------------------------------- R5 ------
+
+def test_tracer_leak_fires():
+    out = findings("""
+        import jax
+
+        acc = []
+
+        @jax.jit
+        def leaky(x):
+            acc.append(x)
+            global last
+            last = x
+            return x
+
+        class Model:
+            @jax.jit
+            def fwd(self, x):
+                self.cache = x
+                return x
+        """, "tracer-leak")
+    assert len(out) == 3
+
+
+def test_tracer_leak_clean_local_mutation():
+    out = findings("""
+        import jax
+
+        @jax.jit
+        def fine(x):
+            parts = []
+            parts.append(x)
+            table = {}
+            table["x"] = x
+            return parts[0] + table["x"]
+        """, "tracer-leak")
+    assert out == []
+
+
+# ----------------------------------------------------- suppressions -------
+
+def test_inline_suppression():
+    res = lint_source(textwrap.dedent("""
+        import jax
+
+        def sample(key):
+            a = jax.random.normal(key)
+            b = jax.random.normal(key)  # jaxlint: disable=prng-key-reuse
+            return a + b
+        """), "fixture.py")
+    assert res.findings == [] and len(res.suppressed) == 1
+    assert res.clean
+
+
+def test_file_wide_suppression():
+    res = lint_source(textwrap.dedent("""
+        # jaxlint: disable-file=prng-key-reuse
+        import jax
+
+        def sample(key):
+            return jax.random.normal(key) + jax.random.normal(key)
+        """), "fixture.py")
+    assert res.findings == [] and len(res.suppressed) == 1
+
+
+def test_suppression_is_rule_specific():
+    res = lint_source(textwrap.dedent("""
+        import jax
+
+        def sample(key):
+            a = jax.random.normal(key)
+            b = jax.random.normal(key)  # jaxlint: disable=tracer-leak
+            return a + b
+        """), "fixture.py")
+    assert len(res.findings) == 1
+
+
+# -------------------------------------------------------------- CLI -------
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n"
+        "def f(key):\n"
+        "    return jax.random.normal(key) + jax.random.uniform(key)\n")
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    assert lint_main([str(bad)]) == 1
+    assert "prng-key-reuse" in capsys.readouterr().out
+    assert lint_main([str(good)]) == 0
+    assert lint_main(["--list-rules"]) == 0
+    assert lint_main([str(bad), "--rules", "tracer-leak"]) == 0
+    assert lint_main([str(bad), "--rules", "no-such-rule"]) == 2
+
+
+def test_rule_catalog_covers_all_five_families():
+    assert set(RULES) == {
+        "prng-key-reuse", "host-sync-in-jit", "recompile-hazard",
+        "use-after-donation", "tracer-leak",
+    }
+
+
+def test_syntax_error_reported_not_raised(tmp_path):
+    res = lint_source("def broken(:\n", "broken.py")
+    assert res.errors and not res.clean

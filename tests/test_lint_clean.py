@@ -1,0 +1,51 @@
+"""Tier-1 lint gate: the whole d4pg_tpu package must lint clean.
+
+Every hazard jaxlint can see in this codebase is either fixed or carries
+an inline ``# jaxlint: disable=<rule>`` suppression whose comment explains
+why the pattern is deliberate. A new finding here means a PR introduced a
+throughput/correctness hazard (or a rule regression) — fix the code or
+justify a suppression, don't weaken the gate.
+
+Marked ``lint`` so the whole-repo AST pass can be deselected with
+``-m "not lint"`` when iterating on unrelated tests.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+import d4pg_tpu
+from d4pg_tpu.lint import lint_paths
+
+PACKAGE_DIR = os.path.dirname(os.path.abspath(d4pg_tpu.__file__))
+REPO_ROOT = os.path.dirname(PACKAGE_DIR)
+
+
+@pytest.mark.lint
+def test_package_lints_clean():
+    result = lint_paths([PACKAGE_DIR])
+    msgs = [f.format() for f in result.findings] + result.errors
+    assert result.clean, (
+        "jaxlint found unsuppressed hazards:\n" + "\n".join(msgs))
+
+
+@pytest.mark.lint
+def test_bench_and_entrypoints_lint_clean():
+    """The scripts feeding the headline numbers are held to the same bar."""
+    files = [os.path.join(REPO_ROOT, n) for n in ("bench.py",)]
+    result = lint_paths([f for f in files if os.path.exists(f)])
+    msgs = [f.format() for f in result.findings] + result.errors
+    assert result.clean, (
+        "jaxlint found unsuppressed hazards:\n" + "\n".join(msgs))
+
+
+@pytest.mark.lint
+def test_cli_module_entrypoint():
+    """`python -m d4pg_tpu.lint <package>` is the documented interface; it
+    must agree with the library API and exit 0 on the repo."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "d4pg_tpu.lint", PACKAGE_DIR],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,74 @@
+"""Runtime perf sentinels (d4pg_tpu/io/profiling.py).
+
+The recompile sentinel must trip on a deliberately-recompiling function
+(fresh shape every call — the classic unstable-signature bug) and stay
+silent over a steady-state jitted loop; the transfer sentinel must count
+explicit host<->device crossings and restore jax's entry points on exit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from d4pg_tpu.io.profiling import (
+    RecompileError, RecompileSentinel, StepTimer, TransferSentinel,
+)
+
+
+def test_recompile_sentinel_trips_on_shape_churn():
+    f = jax.jit(lambda x: (x * 2.0).sum())
+    f(jnp.ones(4))  # warmup
+    with RecompileSentinel() as sentinel:
+        for n in range(5, 8):  # new shape every call -> new compilation
+            f(jnp.ones(n))
+    assert sentinel.compilations > 0
+    with pytest.raises(RecompileError, match="XLA compilation"):
+        sentinel.assert_clean("shape-churn loop")
+
+
+def test_recompile_sentinel_clean_on_stable_loop():
+    f = jax.jit(lambda x: (x * 3.0).sum())
+    f(jnp.ones(16))  # warmup
+    with RecompileSentinel() as sentinel:
+        for _ in range(10):
+            f(jnp.ones(16))
+    sentinel.assert_clean()
+    assert sentinel.compilations == 0
+
+
+def test_recompile_sentinel_ignores_outside_region():
+    f = jax.jit(lambda x: x + 1.0)
+    with RecompileSentinel() as sentinel:
+        pass  # nothing compiled inside the bracket
+    f(jnp.ones(33))  # compilation AFTER exit must not count
+    assert sentinel.compilations == 0
+    sentinel.assert_clean()
+
+
+def test_transfer_sentinel_counts_and_restores():
+    orig_put, orig_get = jax.device_put, jax.device_get
+    with TransferSentinel() as t:
+        x = jax.device_put(np.ones(8, np.float32))
+        jax.device_get(x)
+        jax.device_put(np.zeros(2))
+    assert (t.h2d, t.d2h, t.total) == (2, 1, 3)
+    assert jax.device_put is orig_put and jax.device_get is orig_get
+
+
+def test_transfer_sentinel_zero_for_on_device_work():
+    f = jax.jit(lambda x: x * 2)
+    x = jax.device_put(np.ones(8, np.float32))
+    f(x)  # warmup outside the bracket
+    with TransferSentinel() as t:
+        y = f(x)
+        y = f(y)
+    assert t.total == 0
+
+
+def test_step_timer_rate():
+    timer = StepTimer(alpha=0.5)
+    assert timer.stop(10) is None  # stop without start: no measurement
+    timer.start()
+    rate = timer.stop(100)
+    assert rate is not None and rate > 0
