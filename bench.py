@@ -202,6 +202,95 @@ def bench_fused(k: int = 40, capacity: int = 200_000,
     return rates, recompiles.compilations, transfers.total
 
 
+def bench_ingest(capacity: int = 200_000, block_rows: int = 4096,
+                 rows: int = 65_536, per_row_rows: int = 1024) -> dict:
+    """Ingest-plane throughput (rows/sec): the vectorized block drain
+    (solo), the old one-dispatch-per-row drain it replaced (the measured
+    baseline for the ≥10x claim), and the block drain OVERLAPPED with
+    fused chunks — the shipped schedule (``learner/pipeline.IngestOverlap``:
+    commit block t, dispatch chunk t, device_put block t+1 under chunk
+    t's compute) — with the ≤ 1 explicit-H2D-per-chunk invariant checked
+    by ``TransferSentinel`` and zero steady-state recompiles asserted."""
+    import jax
+
+    from d4pg_tpu.io.profiling import RecompileSentinel, TransferSentinel
+    from d4pg_tpu.learner import init_state
+    from d4pg_tpu.learner.fused import make_fused_chunk
+    from d4pg_tpu.replay.fused_buffer import FusedDeviceReplay
+
+    rng = np.random.default_rng(0)
+    feed = _random_batch(rng, (block_rows,))  # reused: ingest cost, not rng
+
+    def fresh():
+        buf = FusedDeviceReplay(capacity, OBS_DIM, ACT_DIM, alpha=0.6,
+                                block_rows=block_rows)
+        buf.add(feed)
+        buf.drain()  # warm the stage/commit compile
+        jax.block_until_ready(buf.storage.obs)
+        return buf
+
+    # -- solo block drain --------------------------------------------------
+    buf = fresh()
+    n_blocks = max(1, rows // block_rows)
+    t0 = time.perf_counter()
+    drained = 0
+    for _ in range(n_blocks):
+        buf.add(feed)
+        drained += buf.drain()
+    jax.block_until_ready(buf.storage.obs)
+    solo = drained / (time.perf_counter() - t0)
+
+    # -- per-row baseline (the path this PR removed from the hot loop) -----
+    buf = fresh()
+    small = _random_batch(rng, (8,))
+    buf.add(small)
+    buf.drain_per_row()  # warm the 1-row write/insert compiles
+    buf.add(_random_batch(rng, (per_row_rows,)))
+    t0 = time.perf_counter()
+    n_rows = buf.drain_per_row()
+    jax.block_until_ready(buf.storage.obs)
+    per_row = n_rows / (time.perf_counter() - t0)
+
+    # -- concurrent with the fused chunk (the shipped overlap schedule) ----
+    k, steps = 40, 800
+    config = _bench_config()
+    state = init_state(config, jax.random.key(0))
+    buf = fresh()
+    _fill(buf, capacity, rng, drain=True)
+    fn = make_fused_chunk(config, k=k, batch_size=BATCH, prioritized=True,
+                          alpha=0.6, donate=True)
+    state, buf.trees, m = fn(state, buf.trees, buf.storage, buf.size)
+    jax.block_until_ready(m["critic_loss"])
+    buf.add(feed)
+    buf.stage_block()  # prime the double buffer
+    n_dispatch = max(1, steps // k)
+    committed = 0
+    with RecompileSentinel() as rec, TransferSentinel() as tr:
+        t0 = time.perf_counter()
+        for _ in range(n_dispatch):
+            committed += buf.commit_staged()
+            state, buf.trees, m = fn(state, buf.trees, buf.storage,
+                                     buf.size)
+            buf.add(feed)  # actors keep streaming
+            buf.stage_block()  # H2D overlaps the in-flight chunk
+        jax.block_until_ready(m["critic_loss"])
+        dt = time.perf_counter() - t0
+    rec.assert_clean("bench_ingest concurrent loop")
+    assert tr.h2d <= n_dispatch + 1, (
+        f"{tr.h2d} explicit H2D over {n_dispatch} chunks breaks the "
+        "<=1-per-chunk invariant")
+    return {
+        "solo": round(solo, 1),
+        "concurrent": round(committed / dt, 1),
+        "per_row_baseline": round(per_row, 1),
+        "speedup_vs_per_row": round(solo / per_row, 1) if per_row else None,
+        "concurrent_grad_steps_per_sec": round(n_dispatch * k / dt, 2),
+        "block_rows": block_rows,
+        "h2d_per_chunk": round(tr.h2d / n_dispatch, 3),
+        "steady_state_recompiles": rec.compilations,
+    }
+
+
 def bench_projection_variants(k: int = 40, steps: int = 1600) -> dict | None:
     """K-scan update rate per --projection implementation (einsum / pallas
     / pallas_ce) at the bench shape — the measurement backing the
@@ -472,10 +561,18 @@ def main():
         return
 
     backend = ensure_backend(timeout=180.0)
+    # resolve the projection variant the way train.py's '--projection auto'
+    # default does (ops/autotune.py: measured on TPU, static einsum
+    # elsewhere) and record the decision in the artifact
+    from d4pg_tpu.ops.autotune import select_projection
+
+    proj_sel = select_projection(
+        "auto", batch_size=BATCH, v_min=0.0, v_max=800.0, n_atoms=N_ATOMS)
     device_only = bench_tpu()
     fused_rates, fused_recompiles, fused_transfers = bench_fused()
     fused = float(np.median(fused_rates))
     host_pipeline = bench_end_to_end()
+    ingest = bench_ingest()
     baseline = bench_reference_torch_cpu() or RECORDED_BASELINE_SPS
     flops = model_flops_per_step()
     peak = peak_flops_per_sec() if backend == "accel" else None
@@ -498,6 +595,12 @@ def main():
         "steady_state_recompiles": fused_recompiles,
         "steady_state_explicit_transfers": fused_transfers,
         "host_pipeline_e2e": round(host_pipeline, 2),
+        # ingest plane (rows/sec): block drain solo + overlapped with the
+        # fused chunk, vs the old per-row drain; h2d_per_chunk must be
+        # <= 1 (TransferSentinel-checked in bench_ingest)
+        "ingest_rows_per_sec": ingest,
+        # the '--projection auto' decision on this chip/shape (ops/autotune)
+        "projection_autotune": proj_sel.as_json(),
         "baseline_torch_cpu": round(baseline, 2),
         # host-projection-bound ceiling of the reference on ANY GPU —
         # the measurable stand-in for the ">=10x single-A100" north star

@@ -18,7 +18,7 @@ from d4pg_tpu.distributed.actor import (
     ActorWorker,
     GoalActorWorker,
 )
-from d4pg_tpu.distributed.transport import TransitionSender
+from d4pg_tpu.distributed.transport import CoalescingSender, TransitionSender
 from d4pg_tpu.distributed.weight_server import WeightClient
 from d4pg_tpu.envs import EnvPool
 from d4pg_tpu.replay.uniform import TransitionBatch
@@ -54,7 +54,11 @@ def run_actor(
     cfg = cfg.resolve()
     obs_dim, act_dim, obs_dtype = infer_dims(cfg)
     config = cfg.learner_config(obs_dim, act_dim)
-    sender = TransitionSender(learner_host, transitions_port,
+    # Block-coalescing transport (docs/architecture.md "Ingest plane"):
+    # per-tick rows ride one frame per block instead of one frame per
+    # send, with backpressure-aware block sizing. Episode boundaries and
+    # close() flush partial blocks.
+    sender = CoalescingSender(learner_host, transitions_port,
                               actor_id=actor_id, secret=secret)
     weights = WeightClient(learner_host, weights_port, secret=secret)
     actor_cfg = ActorConfig(
@@ -99,6 +103,7 @@ def run_actor(
                 chunk = 1000 if max_ticks is None else min(1000, max_ticks - done)
                 actor.run(chunk)
                 done += chunk
+            sender.flush()  # partial blocks must not outlive the tick loop
     except (KeyboardInterrupt, ConnectionError, BrokenPipeError, OSError) as e:
         print(f"actor {actor_id} stopping: {type(e).__name__}: {e}")
     finally:

@@ -94,9 +94,15 @@ class ExperimentConfig:
     v_max: float | None = None  # --v_max
     n_atoms: int = 51  # --n_atoms
     critic_family: str = "categorical"
-    # 'einsum' (MXU matmul formulation, default) | 'pallas' (fused VMEM
-    # kernel, ops/projection.py) — see README "Projection kernels"
-    projection: str = "einsum"
+    # Categorical Bellman-projection impl: 'auto' (default) runs the
+    # startup micro-autotuner (ops/autotune.py) which times einsum /
+    # pallas / pallas_ce on the actual shapes and picks the winner
+    # (BENCH_r05: einsum wins at the bench shape — but that is a measured
+    # fact of (batch, atoms, chip), not a constant); an explicit variant
+    # is the escape hatch and is honored verbatim. Non-TPU backends and
+    # mesh learners resolve to einsum without timing (see ops/autotune.py
+    # policy). The selection is logged at startup.
+    projection: str = "auto"
     hidden: tuple = (256, 256, 256)
     compute_dtype: str = "float32"  # 'bfloat16' for MXU-native matmuls
     # exploration
@@ -229,6 +235,21 @@ class ExperimentConfig:
         selects the conv-encoder pixel path (BASELINE.md config #4)."""
         resolved = self.resolve()
         pixels = not np.isscalar(obs_dim)
+        projection = self.projection
+        if projection == "auto":
+            # D4PGConfig is the jit-static config — 'auto' must resolve to
+            # a concrete variant BEFORE it is built. The autotuner times
+            # the candidates on the actual (batch, atoms) shapes on TPU;
+            # mesh/multi-host and non-TPU backends resolve statically to
+            # einsum (see ops/autotune.py). Explicit flags bypass all this.
+            from d4pg_tpu.ops.autotune import select_projection
+
+            mesh = (self.data_parallel > 1 or self.num_processes > 1
+                    or bool(self.coordinator))
+            projection = select_projection(
+                "auto", batch_size=self.batch_size,
+                v_min=float(resolved.v_min), v_max=float(resolved.v_max),
+                n_atoms=self.n_atoms, mesh=mesh).selected
         return D4PGConfig(
             obs_dim=int(np.prod(obs_dim)) if pixels else obs_dim,
             pixels=pixels,
@@ -239,7 +260,7 @@ class ExperimentConfig:
             n_atoms=self.n_atoms,
             hidden=tuple(self.hidden),
             critic_family=self.critic_family,
-            projection=self.projection,
+            projection=projection,
             augment=self.augment,
             augment_pad=self.augment_pad,
             share_encoder=self.share_encoder,
@@ -318,12 +339,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--critic_family", choices=("categorical", "mog"),
                    default=d.critic_family)
     p.add_argument("--projection",
-                   choices=("einsum", "pallas", "pallas_ce"),
+                   choices=("auto", "einsum", "pallas", "pallas_ce"),
                    default=d.projection,
-                   help="categorical Bellman-projection impl: MXU einsum "
-                        "(default), the VMEM Pallas projection kernel, or "
-                        "pallas_ce (projection fused into the cross-"
-                        "entropy loss, forward + backward)")
+                   help="categorical Bellman-projection impl: 'auto' "
+                        "(default) micro-autotunes on the actual shapes "
+                        "at startup; or pin the MXU einsum, the VMEM "
+                        "Pallas projection kernel, or pallas_ce "
+                        "(projection fused into the cross-entropy loss, "
+                        "forward + backward)")
     p.add_argument("--compute_dtype", choices=("float32", "bfloat16"),
                    default=d.compute_dtype)
     p.add_argument("--noise", choices=("gaussian", "ou"), default=d.noise)

@@ -131,16 +131,41 @@ class ReplayService:
                                               generation=generation)
 
     def drain_device(self) -> int:
-        """Flush rows staged by a fused-path buffer
+        """Flush ALL rows staged by a fused-path buffer
         (``replay/fused_buffer.py``) onto the device. Called by the
-        LEARNER thread at chunk boundaries — it is the single owner of the
-        device handles, so the drain thread's ``add`` only stages host
-        rows and never dispatches device work."""
+        LEARNER thread at cycle/chunk boundaries — it is the single owner
+        of the device handles, so the drain thread's ``add`` only stages
+        host rows and never dispatches device work."""
         drain = getattr(self.buffer, "drain", None)
         if drain is None:
             return 0
         with self._buffer_lock:
             return drain()
+
+    def ingest_commit(self) -> int:
+        """Land the in-flight staged block (one jitted ring-write + tree
+        insert dispatch; no explicit H2D). Learner thread, called right
+        BEFORE a fused-chunk dispatch so the chunk samples the freshest
+        rows. No-op (0) for buffers without the block-drain API."""
+        commit = getattr(self.buffer, "commit_staged", None)
+        if commit is None:
+            return 0
+        with self._buffer_lock:
+            return commit()
+
+    def ingest_stage(self) -> int:
+        """Start the H2D transfer of the next staged block (ONE
+        ``jax.device_put``). Learner thread, called right AFTER a fused
+        chunk is dispatched so the transfer overlaps the chunk's compute
+        — the ≤ 1 explicit-H2D-per-chunk schedule
+        (``learner/pipeline.IngestOverlap``). Falls back to a full
+        synchronous drain for buffers without the block API (sharded
+        fused replay), preserving the old per-chunk semantics there."""
+        stage = getattr(self.buffer, "stage_block", None)
+        if stage is None:
+            return self.drain_device()
+        with self._buffer_lock:
+            return stage()
 
     def replay_state(self) -> dict:
         """Buffer contents + priorities for checkpointing (learner
@@ -186,12 +211,27 @@ class ReplayService:
             ]
 
     # -- internals ---------------------------------------------------------
+    # Max batches folded into one coalesced insert pass: bounds the lock
+    # hold (the learner's sample path waits on the same lock) while still
+    # amortizing it ~64x under a streaming fleet.
+    _COALESCE = 64
+
     def _drain(self) -> None:
         while not self._stop.is_set():
             try:
-                _, batch, count = self._queue.get(timeout=0.1)
+                batches = [self._queue.get(timeout=0.1)]
             except queue.Empty:
                 continue
+            # Coalesce: take everything already queued (up to _COALESCE)
+            # so a streaming fleet pays ONE lock acquisition and one
+            # normalizer fold per group instead of per actor send — the
+            # ingest plane's host-side amortization, matching the
+            # block-granular device drain downstream.
+            while len(batches) < self._COALESCE:
+                try:
+                    batches.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
             try:
                 if self.obs_norm is not None:
                     # Only obs rows feed the estimator; next_obs is
@@ -201,19 +241,24 @@ class ReplayService:
                     # tags every n-step fold of a terminal AND HER success
                     # relabels mid-trajectory, so done-gating would weight
                     # terminal-adjacent states 2-5x instead), and the
-                    # omission is one state in T per episode.
-                    self.obs_norm.update(batch.obs)
-                    batch = batch._replace(
-                        obs=self.obs_norm.normalize(batch.obs),
-                        next_obs=self.obs_norm.normalize(batch.next_obs),
-                    )
+                    # omission is one state in T per episode. Stats fold
+                    # BEFORE any of the group's rows are normalized, in
+                    # arrival order — same estimator as the per-batch loop.
+                    for j, (aid, batch, cnt) in enumerate(batches):
+                        self.obs_norm.update(batch.obs)
+                        batches[j] = (aid, batch._replace(
+                            obs=self.obs_norm.normalize(batch.obs),
+                            next_obs=self.obs_norm.normalize(batch.next_obs),
+                        ), cnt)
                 with self._buffer_lock:
-                    self.buffer.add(batch)
+                    for _aid, batch, _cnt in batches:
+                        self.buffer.add(batch)
             finally:
                 with self._lock:
-                    if count:
-                        self._env_steps += batch.obs.shape[0]
-                    self._pending -= 1
+                    for _, batch, count in batches:
+                        if count:
+                            self._env_steps += batch.obs.shape[0]
+                    self._pending -= len(batches)
 
     def flush(self, timeout: float = 5.0) -> None:
         """Block until every accepted batch has been inserted."""

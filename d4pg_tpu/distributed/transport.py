@@ -256,6 +256,112 @@ class TransitionSender(ReconnectingClient):
                     self._drop_sock()
 
 
+class CoalescingSender(TransitionSender):
+    """Actor-side block coalescing: many small ``send`` calls become ONE
+    wire frame per block (the ingest plane's transport stage).
+
+    Per-tick sends dominate the DCN plane's measured ~5,200 rows/s/core
+    ceiling with framing + npz header overhead: each frame pays the
+    length-prefixed header, the npz directory, and a receiver wakeup for
+    a handful of rows. This subclass accumulates rows column-major into
+    PREALLOCATED per-field arrays (allocated once from the first batch's
+    shapes/dtypes — uint8 pixels stay packed; appends are slice copies,
+    no per-row serialization) and flushes one contiguous frame when the
+    block fills, when ``flush_interval`` elapses, or when the
+    ``count_env_steps`` flag changes (the flag is per-frame on the wire,
+    so HER relabels never merge with real env rows).
+
+    Backpressure-aware sizing: the target block grows toward
+    ``max_block`` while the previous flush observed TCP backpressure (a
+    slow ``sendall`` means the learner is the bottleneck — bigger blocks
+    amortize framing exactly when it matters) and decays toward
+    ``min_block`` when sends are fast (small blocks keep ingest latency
+    low when the plane has headroom).
+    """
+
+    def __init__(self, host: str, port: int, actor_id: str = "remote",
+                 connect_timeout: float = 10.0, secret: Optional[str] = None,
+                 retry_timeout: float = 300.0, min_block: int = 64,
+                 max_block: int = 4096, flush_interval: float = 0.25):
+        super().__init__(host, port, actor_id,
+                         connect_timeout=connect_timeout, secret=secret,
+                         retry_timeout=retry_timeout)
+        self._min_block = max(1, int(min_block))
+        self._max_block = max(self._min_block, int(max_block))
+        self._target = self._min_block
+        self._flush_interval = float(flush_interval)
+        self._cols: Optional[list] = None  # per-field [max_block, ...] arrays
+        self._fill = 0
+        self._count_flag = True
+        self._first_row_t = 0.0
+        self._block_lock = threading.Lock()
+
+    def _ensure_cols(self, batch: TransitionBatch) -> None:
+        if self._cols is None:
+            self._cols = [
+                np.empty((self._max_block, *np.asarray(v).shape[1:]),
+                         np.asarray(v).dtype)
+                for v in batch
+            ]
+
+    def send(self, batch: TransitionBatch, count_env_steps: bool = True) -> None:
+        import time
+
+        n = np.asarray(batch.obs).shape[0]
+        if n == 0:
+            return
+        with self._block_lock:
+            self._ensure_cols(batch)
+            if self._fill and count_env_steps != self._count_flag:
+                self._flush_locked()  # flags can't share a frame
+            self._count_flag = count_env_steps
+            done = 0
+            while done < n:
+                if self._fill == 0:
+                    self._first_row_t = time.monotonic()
+                take = min(n - done, self._max_block - self._fill)
+                for col, v in zip(self._cols, batch):
+                    col[self._fill:self._fill + take] = \
+                        np.asarray(v)[done:done + take]
+                self._fill += take
+                done += take
+                if (self._fill >= self._target
+                        or time.monotonic() - self._first_row_t
+                        >= self._flush_interval):
+                    self._flush_locked()
+
+    def flush(self) -> None:
+        """Ship any partially-filled block now (episode/shutdown
+        boundaries)."""
+        with self._block_lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        import time
+
+        if not self._fill:
+            return
+        frame = TransitionBatch(*[col[:self._fill] for col in self._cols])
+        n = self._fill
+        self._fill = 0
+        t0 = time.monotonic()
+        super().send(frame, count_env_steps=self._count_flag)
+        dt = time.monotonic() - t0
+        # > 2ms/KRow on the wire = kernel buffers pushing back: grow the
+        # block so framing amortizes; fast sends decay toward min_block
+        if dt > 0.002 * max(1.0, n / 1000.0):
+            self._target = min(self._target * 2, self._max_block)
+        else:
+            self._target = max(self._target // 2, self._min_block)
+
+    def close(self) -> None:
+        try:
+            self.flush()
+        except (ConnectionError, OSError):
+            pass  # peer already gone; pending rows are benign to lose
+        super().close()
+
+
 class ConnRegistry:
     """Tracking + teardown of a server's live peer connections, shared by
     ``TransitionReceiver`` and ``WeightServer``: a closed service must

@@ -93,7 +93,10 @@ class D4PGConfig:
     # 'pallas_ce' (projection FUSED into the cross-entropy reduction with
     # a custom VJP, ops/projection_ce.py — removes the proj round trip in
     # both passes; see README "Projection kernels"). Categorical family
-    # only; ignored by MoG.
+    # only; ignored by MoG. This field is jit-static and must be CONCRETE:
+    # the experiment-level '--projection auto' default resolves to one of
+    # these via the startup micro-autotuner BEFORE building this config
+    # (config.ExperimentConfig.learner_config -> ops/autotune.py).
     projection: str = "einsum"
 
     def __post_init__(self):

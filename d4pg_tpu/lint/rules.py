@@ -1,14 +1,15 @@
 """The jaxlint rule catalog.
 
-Five rule families, each targeting a hazard that silently costs throughput
+Six rule families, each targeting a hazard that silently costs throughput
 or correctness on this stack (see docs/architecture.md "Static analysis &
 perf sentinels" for the rationale and suppression policy):
 
-- ``prng-key-reuse``     — same key consumed by two samplers
-- ``host-sync-in-jit``   — host/device sync points under a trace
-- ``recompile-hazard``   — patterns that defeat the jit cache
-- ``use-after-donation`` — reading a buffer after ``donate_argnums`` took it
-- ``tracer-leak``        — mutating outer state from inside a trace
+- ``prng-key-reuse``       — same key consumed by two samplers
+- ``host-sync-in-jit``     — host/device sync points under a trace
+- ``recompile-hazard``     — patterns that defeat the jit cache
+- ``use-after-donation``   — reading a buffer after ``donate_argnums`` took it
+- ``tracer-leak``          — mutating outer state from inside a trace
+- ``device-put-in-loop``   — per-item H2D transfers in a Python loop
 
 Every rule is a function ``(ModuleContext) -> list[Finding]`` registered in
 ``RULES``. Rules are deliberately conservative: a finding should be either
@@ -534,6 +535,52 @@ def rule_tracer_leak(ctx: ModuleContext) -> list[Finding]:
 
 
 # --------------------------------------------------------------------------
+# R6: device-put-in-loop
+# --------------------------------------------------------------------------
+
+
+def rule_device_put_in_loop(ctx: ModuleContext) -> list[Finding]:
+    """``jax.device_put`` inside a Python loop: per-item H2D transfers
+    serialize against dispatch and pay per-call overhead every iteration —
+    the exact ingest anti-pattern the block drain removed
+    (``replay/fused_buffer.py``: coalesce rows into a block and transfer
+    ONCE). Loops here are ``for``/``while`` statements in the same
+    function (a comprehension builds one value and a nested function is
+    its own scope, analyzed separately)."""
+    findings: list[Finding] = []
+
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(ctx.tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+
+    def inside_loop(node: ast.AST) -> bool:
+        cur = parents.get(node)
+        while cur is not None and not isinstance(cur, FunctionNode):
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                return True
+            cur = parents.get(cur)
+        return False
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = dotted_name(node.func) or ""
+        parts = dotted.split(".")
+        if parts[-1] != "device_put":
+            continue
+        if len(parts) > 1 and parts[0] not in {"jax"}:
+            continue  # some_obj.device_put: not the jax entry point
+        if inside_loop(node):
+            findings.append(Finding(
+                ctx.path, node.lineno, node.col_offset, "device-put-in-loop",
+                "device_put inside a loop transfers per item; coalesce the "
+                "rows into one block and transfer once (see the block "
+                "drain in replay/fused_buffer.py)"))
+    return findings
+
+
+# --------------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------------
 
@@ -566,4 +613,8 @@ RULES: dict[str, Rule] = {r.id: r for r in [
          "traced code mutating outer state (global/nonlocal/attribute/"
          "closure writes)",
          rule_tracer_leak),
+    Rule("device-put-in-loop",
+         "jax.device_put called inside a Python loop — per-item H2D; "
+         "coalesce into a block and transfer once",
+         rule_device_put_in_loop),
 ]}

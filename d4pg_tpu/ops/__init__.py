@@ -3,9 +3,18 @@
 The compute path is XLA-compiled JAX; these kernels cover the few ops where
 explicit fusion/layout control beats the compiler. Each kernel has a
 reference JAX formulation it is tested against, and callers can select the
-implementation (``method='einsum' | 'pallas'``).
+implementation (``method='einsum' | 'pallas'``) — or leave the config
+default ``'auto'``, which runs the startup micro-autotuner
+(``ops/autotune.py``) to time the variants on the actual shapes and pick
+the winner.
 """
 
+from d4pg_tpu.ops.autotune import (
+    AutotuneResult,
+    autotune_projection,
+    select_projection,
+)
 from d4pg_tpu.ops.projection import projection_pallas
 
-__all__ = ["projection_pallas"]
+__all__ = ["AutotuneResult", "autotune_projection", "projection_pallas",
+           "select_projection"]

@@ -1,0 +1,182 @@
+"""Startup micro-autotuner for the categorical-projection implementation.
+
+BENCH_r05 measured the K-scan update rate per ``--projection`` variant at
+the Humanoid bench shape as einsum 12.6k > pallas 10.3k > pallas_ce 8.7k
+steps/s — i.e. the best variant is an empirical fact of the (batch,
+atoms, chip) triple, not something a default can know. ``--projection
+auto`` (the config default) times the candidates ON THE ACTUAL SHAPES at
+startup and picks the winner; an explicit ``--projection einsum|pallas|
+pallas_ce`` remains the escape hatch and is honored verbatim.
+
+What gets timed: the critic-loss core each variant actually changes —
+``value_and_grad`` of the projected-Bellman cross-entropy at [B, A]
+(projection forward for einsum/pallas, the fused forward+custom-VJP for
+pallas_ce) — under jit, warmed up, best-of-``repeats`` wall time. The
+surrounding network passes are identical across variants and would only
+dilute the signal.
+
+Static policy short-circuits (no timing, reason recorded):
+
+  - non-TPU backends: CPU runs Pallas in interpret mode (measures the
+    emulator, not a kernel) and other backends have no Pallas lowering —
+    einsum is the only real candidate either way;
+  - mesh/multi-host learners: the Pallas kernels have no GSPMD
+    partitioning rule (``parallel/data_parallel.check_mesh_compatible``
+    rejects them), so einsum is the only legal candidate.
+
+Results are cached per (batch, support, backend) so repeated learner
+builds in one process autotune once; the selection is logged once with
+its timings so run logs name the variant actually compiled in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+CANDIDATES = ("einsum", "pallas", "pallas_ce")
+
+
+@dataclasses.dataclass(frozen=True)
+class AutotuneResult:
+    selected: str
+    reason: str
+    timings_ms: dict | None = None  # per-candidate best step time (None =
+    #                                 static policy, nothing was timed)
+
+    def as_json(self) -> dict:
+        return {"selected": self.selected, "reason": self.reason,
+                "timings_ms": self.timings_ms}
+
+
+_CACHE: dict[tuple, AutotuneResult] = {}
+_LOGGED: set[tuple] = set()
+
+
+def _loss_fn(variant: str, support, interpret: bool):
+    import jax
+
+    from d4pg_tpu.core.distribution import categorical_projection
+    from d4pg_tpu.core.losses import categorical_td_loss, weighted_mean
+
+    if variant == "pallas_ce":
+        from d4pg_tpu.ops.projection_ce import projection_ce_pallas
+
+        def loss(pred, tp, r, d):
+            td = projection_ce_pallas(support, tp, r, d, pred, interpret)
+            return weighted_mean(td, None)
+
+        return loss
+
+    if variant == "pallas":
+        from d4pg_tpu.ops.projection import projection_pallas
+
+        def project(tp, r, d):
+            return projection_pallas(support, tp, r, d, interpret)
+    else:
+        def project(tp, r, d):
+            return categorical_projection(support, tp, r, d)
+
+    def loss(pred, tp, r, d):
+        proj = jax.lax.stop_gradient(project(tp, r, d))
+        return categorical_td_loss(proj, pred)[0]
+
+    return loss
+
+
+def _time_variant(variant: str, support, batch_size: int,
+                  repeats: int, iters: int) -> float:
+    """Best-of-``repeats`` wall time (ms) of one jitted grad step of the
+    variant's loss core at [batch_size, n_atoms]."""
+    import jax
+    import jax.numpy as jnp
+
+    interpret = jax.default_backend() == "cpu"
+    rng = np.random.default_rng(0)
+    a = support.n_atoms
+    tp = rng.random((batch_size, a)).astype(np.float32)
+    tp /= tp.sum(-1, keepdims=True)
+    pred = jnp.asarray(tp)
+    tp = jnp.asarray(tp)
+    r = jnp.asarray(rng.standard_normal(batch_size).astype(np.float32))
+    d = jnp.full((batch_size,), 0.99, jnp.float32)
+
+    step = jax.jit(jax.value_and_grad(_loss_fn(variant, support, interpret)))
+    v, g = step(pred, tp, r, d)  # warmup/compile
+    jax.block_until_ready(g)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            v, g = step(pred, tp, r, d)
+        jax.block_until_ready(g)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best * 1e3
+
+
+def autotune_projection(batch_size: int, v_min: float, v_max: float,
+                        n_atoms: int, repeats: int = 3,
+                        iters: int = 20) -> AutotuneResult:
+    """Time every candidate at the given shapes on the live backend and
+    return the winner. TPU-only by policy (see module docstring) — the
+    caller gates; this function times whatever backend is active."""
+    from d4pg_tpu.core.distribution import CategoricalSupport
+
+    support = CategoricalSupport(float(v_min), float(v_max), int(n_atoms))
+    timings = {}
+    for variant in CANDIDATES:
+        try:
+            timings[variant] = round(
+                _time_variant(variant, support, batch_size, repeats, iters),
+                4)
+        except Exception as e:  # a kernel that fails to lower loses, not
+            timings[variant] = None  # the whole startup
+            timings[f"{variant}_error"] = f"{type(e).__name__}: {e}"
+    timed = {k: v for k, v in timings.items() if isinstance(v, float)}
+    if not timed:
+        return AutotuneResult("einsum", "all candidates failed to time",
+                              timings)
+    best = min(timed, key=timed.get)
+    return AutotuneResult(best, "measured fastest grad step at shape "
+                          f"[{batch_size}, {n_atoms}]", timings)
+
+
+def select_projection(flag: str, *, batch_size: int, v_min: float,
+                      v_max: float, n_atoms: int,
+                      mesh: bool = False) -> AutotuneResult:
+    """Resolve a ``--projection`` flag to a concrete implementation.
+
+    Explicit flags pass through untouched (the escape hatch); ``'auto'``
+    applies the static policy, then measures when measuring is
+    meaningful. Logs the selection (once per distinct choice) so every
+    run names the variant it trains with."""
+    if flag != "auto":
+        return AutotuneResult(flag, "explicit --projection override")
+    import jax
+
+    backend = jax.default_backend()
+    key = ("sel", batch_size, float(v_min), float(v_max), int(n_atoms),
+           bool(mesh), backend)
+    if key not in _CACHE:
+        if mesh:
+            result = AutotuneResult(
+                "einsum", "mesh learner: Pallas kernels have no GSPMD "
+                "partitioning rule (einsum is the only legal candidate)")
+        elif backend != "tpu":
+            result = AutotuneResult(
+                "einsum", f"{backend} backend: Pallas would run in "
+                "interpret/fallback mode — nothing real to time")
+        else:
+            result = autotune_projection(batch_size, v_min, v_max, n_atoms)
+        _CACHE[key] = result
+    result = _CACHE[key]
+    log_key = (key, result.selected)
+    if log_key not in _LOGGED:
+        _LOGGED.add(log_key)
+        timed = (f" timings_ms={result.timings_ms}"
+                 if result.timings_ms else "")
+        print(f"[autotune] projection='{result.selected}' "
+              f"({result.reason}){timed}", flush=True)
+    return result

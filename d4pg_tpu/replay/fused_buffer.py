@@ -3,12 +3,30 @@
 Companion to ``learner/fused.py``. Ownership model (the part that makes
 cross-thread donation safe): ``add`` — called from the ReplayService
 drain thread under the buffer lock — only STAGES host rows; every device
-mutation (ring scatter, tree insert, and the fused chunk's tree
-write-back) happens on the learner thread, which is the single owner of
-the ``trees``/storage handles. ``drain()`` flushes staged rows at chunk
-boundaries, so inserts take effect between chunks — the same semantics
-the host-PER path gets from its buffer lock, without the learner ever
-blocking on actor ingest.
+mutation (ring write, tree insert, and the fused chunk's tree write-back)
+happens on the learner thread, which is the single owner of the
+``trees``/storage handles. Staged rows take effect between chunks — the
+same semantics the host-PER path gets from its buffer lock, without the
+learner ever blocking on actor ingest.
+
+Ingest fast path (the batched block drain; docs/architecture.md "Ingest
+plane"): ``add`` copies rows column-major into a PREALLOCATED host
+staging ring (no per-drain ``np.concatenate``, no per-row device work).
+The learner moves a block with exactly two calls:
+
+  - ``stage_block()`` — ONE ``jax.device_put`` of a fixed-shape
+    [block_rows] frame (the H2D transfer; async under dispatch, so it
+    overlaps the in-flight fused chunk's compute),
+  - ``commit_staged()`` — ONE jitted dispatch fusing the two-slice ring
+    write (``device_ring.block_write``) with the PER tree insert at
+    ``max_priority ** alpha``; storage and trees are donated.
+
+``drain()`` loops stage+commit until the staging ring is empty (cycle
+boundaries, checkpointing); the overlapped schedule in
+``learner/pipeline.IngestOverlap`` interleaves the two calls with fused
+chunks so steady state pays ≤ 1 explicit H2D per chunk. ``drain_per_row``
+keeps the old one-dispatch-per-row path as the measured baseline and the
+bitwise-equivalence oracle (tests/test_ingest.py, bench.py).
 
 The generation guard the host path needs (``prioritized.py`` — a sampled
 slot overwritten before its priority lands) is structurally unnecessary
@@ -22,12 +40,78 @@ the accelerator.
 
 from __future__ import annotations
 
+from functools import partial
+
 import numpy as np
 
 from d4pg_tpu.replay import device_per as dper
-from d4pg_tpu.replay.device_ring import DeviceStore
-from d4pg_tpu.replay.segment_tree import next_pow2
+from d4pg_tpu.replay.device_ring import DeviceStore, block_write
 from d4pg_tpu.replay.uniform import TransitionBatch
+
+
+class HostStagingRing:
+    """Preallocated column-major host staging for fixed-shape block frames.
+
+    One contiguous buffer per transition field, ``n_blocks * block_rows``
+    rows plus a ``block_rows`` scratch tail so the next frame is ALWAYS a
+    contiguous in-bounds [block_rows] view (a partial or boundary-capped
+    frame just carries a smaller valid count ``n``; rows past ``n`` are
+    stale scratch masked out on device). ``push`` is slice assignment —
+    the only host copy a row ever pays — and ``frame`` is zero-copy.
+
+    Bounded like the list staging it replaces: when producers outrun the
+    learner by more than the ring, the OLDEST staged rows are dropped
+    (they would only be overwritten by the next drains anyway).
+
+    Reuse discipline: a popped frame's rows are rewritten only after the
+    write pointer laps the ring (≥ ``(n_blocks - 1) * block_rows`` newer
+    rows), which keeps them intact for the duration of the in-flight
+    ``device_put`` even on backends that complete H2D asynchronously.
+    """
+
+    def __init__(self, specs, block_rows: int, n_blocks: int):
+        self.block_rows = int(block_rows)
+        self.n_blocks = max(2, int(n_blocks))
+        self.size = self.block_rows * self.n_blocks
+        self._arrays = [
+            np.zeros((self.size + self.block_rows, *shape), dtype)
+            for shape, dtype in specs
+        ]
+        self._r = 0  # absolute rows consumed
+        self._w = 0  # absolute rows written
+
+    def __len__(self) -> int:
+        return self._w - self._r
+
+    def push(self, batch: TransitionBatch) -> None:
+        n = batch.obs.shape[0]
+        if n > self.size:  # keep only the newest ring-full
+            batch = TransitionBatch(*[np.asarray(v)[-self.size:]
+                                      for v in batch])
+            n = self.size
+        off = self._w % self.size
+        first = min(n, self.size - off)
+        for dst, src in zip(self._arrays, batch):
+            src = np.asarray(src)
+            dst[off:off + first] = src[:first]
+            if first < n:
+                dst[:n - first] = src[first:]
+        self._w += n
+        if self._w - self._r > self.size:
+            self._r = self._w - self.size  # drop oldest
+
+    def frame(self) -> tuple[TransitionBatch, int]:
+        """Next pending frame as fixed-shape [block_rows] views + its
+        valid row count (0 when empty). Capped at the ring boundary so
+        the views stay contiguous."""
+        off = self._r % self.size
+        n = min(self._w - self._r, self.block_rows, self.size - off)
+        views = TransitionBatch(*[a[off:off + self.block_rows]
+                                  for a in self._arrays])
+        return views, n
+
+    def pop(self, n: int) -> None:
+        self._r += n
 
 
 class FusedDeviceReplay:
@@ -42,84 +126,161 @@ class FusedDeviceReplay:
         prioritized: bool = True,
         obs_dtype=None,
         device=None,
+        block_rows: int | None = None,
+        staging_blocks: int = 8,
     ):
         self.capacity = int(capacity)
         obs_shape = (obs_dim,) if np.isscalar(obs_dim) else tuple(obs_dim)
         if obs_dtype is None:
             obs_dtype = np.float32 if len(obs_shape) == 1 else np.uint8
+        self.block_rows = int(block_rows if block_rows is not None
+                              else min(4096, self.capacity))
+        self._device = device
         self._store = DeviceStore(self.capacity, obs_shape, act_dim,
-                                  obs_dtype, device=device)
+                                  obs_dtype, device=device,
+                                  block_rows=self.block_rows)
         self.prioritized = bool(prioritized)
         self.alpha = float(alpha)
         self.trees = dper.init(self.capacity) if prioritized else None
         self.size = 0
         self.head = 0
-        self._staged: list[TransitionBatch] = []
-        self._staged_rows = 0
+        obs_dtype = np.dtype(obs_dtype)
+        # staging covers ~one ring (small buffers) capped at
+        # ``staging_blocks`` blocks (big ones): deeper backlogs would only
+        # be overwritten by later drains
+        n_blocks = max(2, min(int(staging_blocks),
+                              -(-self.capacity // self.block_rows)))
+        self._staging = HostStagingRing(
+            [(obs_shape, obs_dtype), ((act_dim,), np.float32),
+             ((), np.float32), (obs_shape, obs_dtype), ((), np.float32),
+             ((), np.float32)],
+            self.block_rows, n_blocks)
+        self._inflight: tuple[TransitionBatch, int] | None = None
+        self._commit = self._make_commit()
+
+    def _make_commit(self):
+        import jax
+        import jax.numpy as jnp
+
+        capacity, block, alpha = self.capacity, self.block_rows, self.alpha
+        write = partial(block_write, capacity=capacity, block_rows=block)
+
+        if not self.prioritized:
+            return jax.jit(write, donate_argnums=(0,))
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def commit(storage, trees, frame, start, n):
+            storage = write(storage, frame, start, n)
+            row = jax.lax.iota(jnp.int32, block)
+            # pad rows repeat the first live slot: duplicate writes of the
+            # same value are harmless to the trees (see device_per.insert)
+            idx = jnp.where(row < n, (start + row) % capacity,
+                            start % capacity)
+            trees = dper.insert(trees, idx, alpha)
+            return storage, trees
+
+        return commit
 
     # -- ingest side (any thread, under the service's buffer lock) ---------
     def add(self, batch: TransitionBatch) -> None:
-        """Stage host rows; cheap (no device work, no jit dispatch).
-
-        Staging is bounded at ~ring capacity: if the learner pauses (long
-        eval, checkpoint) while actors keep streaming, the oldest staged
-        batches are dropped — they would only be overwritten by the next
-        drain anyway, and an unbounded backlog could otherwise OOM the
-        host (the host-buffer path is bounded at ring capacity too)."""
-        n = batch.obs.shape[0]
-        if n == 0:
+        """Stage host rows into the preallocated column-major staging ring;
+        cheap (slice copies — no device work, no jit dispatch). Staging is
+        bounded: if the learner pauses (long eval, checkpoint) while actors
+        keep streaming, the oldest staged rows are dropped — they would
+        only be overwritten by the next drain anyway, and an unbounded
+        backlog could otherwise OOM the host."""
+        if batch.obs.shape[0] == 0:
             return
-        if n > self.capacity:
-            raise ValueError(f"batch of {n} exceeds capacity {self.capacity}")
-        self._staged.append(
-            TransitionBatch(*[np.asarray(v) for v in batch]))
-        self._staged_rows += n
-        while (self._staged_rows - self._staged[0].obs.shape[0]
-               >= self.capacity):
-            self._staged_rows -= self._staged.pop(0).obs.shape[0]
+        self._staging.push(batch)
 
     def __len__(self) -> int:
-        # staged rows count toward warmup gates — they WILL be trained on
-        # (drained before the next chunk)
-        return min(self.size + self._staged_rows, self.capacity)
+        # staged + in-flight rows count toward warmup gates — they WILL be
+        # trained on (drained before the next chunk)
+        inflight = self._inflight[1] if self._inflight is not None else 0
+        return min(self.size + len(self._staging) + inflight, self.capacity)
 
     # -- learner side (single owner of the device handles) -----------------
     @property
     def storage(self) -> TransitionBatch:
         return self._store.arrays
 
-    def drain(self) -> int:
-        """Flush staged rows to the device (ring scatter + tree insert at
-        ``max_priority ** alpha``). Learner thread only. Returns rows
-        flushed."""
-        if not self._staged:
+    def stage_block(self) -> int:
+        """Start the H2D transfer of ONE pending block frame (a single
+        ``jax.device_put`` of the fixed-shape [block_rows] views) — the
+        only explicit transfer the ingest plane makes. No-op while a frame
+        is already in flight (the double-buffer depth is one: block t+1
+        stages while chunk t computes). Returns rows staged."""
+        if self._inflight is not None:
             return 0
-        batch = (self._staged[0] if len(self._staged) == 1 else
-                 TransitionBatch(*[
-                     np.concatenate([np.asarray(b[f]) for b in self._staged])
-                     for f in range(len(self._staged[0]))]))
-        self._staged.clear()
-        self._staged_rows = 0
-        n = batch.obs.shape[0]
-        if n > self.capacity:
-            # more staged than the ring holds: older rows would only be
-            # overwritten — and duplicate slot indices in one scatter have
-            # an unspecified winner, so keep exactly the newest `capacity`
-            self.head = int((self.head + (n - self.capacity)) % self.capacity)
-            batch = TransitionBatch(*[v[-self.capacity:] for v in batch])
-            n = self.capacity
-        idx = ((self.head + np.arange(n)) % self.capacity).astype(np.int32)
-        self._store.write(idx, batch)
+        views, n = self._staging.frame()
+        if n == 0:
+            return 0
+        import jax
+
+        frame = (jax.device_put(views, self._device)
+                 if self._device is not None else jax.device_put(views))
+        self._staging.pop(n)
+        self._inflight = (frame, n)
+        return n
+
+    def commit_staged(self) -> int:
+        """Land the in-flight frame: ONE jitted dispatch fusing the
+        two-slice ring write with the PER tree insert (storage and trees
+        donated). Learner thread only. Returns rows committed."""
+        if self._inflight is None:
+            return 0
+        frame, n = self._inflight
+        self._inflight = None
+        start = np.int32(self.head)
         if self.trees is not None:
-            m = next_pow2(n)
-            if m != n:
-                # pad by repeating live slots: duplicate writes of the same
-                # value are harmless to the trees (see device_per.insert)
-                idx = np.concatenate([idx, np.full(m - n, idx[0], np.int32)])
-            self.trees = dper.insert_jitted(self.trees, idx, self.alpha)
+            storage, self.trees = self._commit(
+                self._store.arrays, self.trees, frame, start, np.int32(n))
+        else:
+            storage = self._commit(self._store.arrays, frame, start,
+                                   np.int32(n))
+        self._store.swap_arrays(storage)
         self.head = int((self.head + n) % self.capacity)
         self.size = int(min(self.size + n, self.capacity))
         return n
+
+    def drain(self) -> int:
+        """Flush ALL staged rows to the device (stage + commit per block
+        until the staging ring is empty). Learner thread only; used at
+        cycle boundaries and before checkpoint snapshots. The overlapped
+        per-chunk schedule calls ``stage_block``/``commit_staged``
+        directly (learner/pipeline.IngestOverlap)."""
+        total = self.commit_staged()
+        while self.stage_block():
+            total += self.commit_staged()
+        return total
+
+    def drain_per_row(self) -> int:
+        """The pre-block reference drain: one scatter dispatch + one tree
+        insert PER ROW. Kept as the measured baseline for
+        ``bench.py``'s ``ingest_rows_per_sec`` speedup claim and as the
+        bitwise-equivalence oracle for the block path (the block drain
+        must land exactly these bytes and priorities). Not used by any
+        shipped loop."""
+        total = self.commit_staged()  # a device-staged frame goes block-wise
+        while True:
+            frame, n = self._staging.frame()
+            if n == 0:
+                break
+            self._staging.pop(n)
+            for i in range(int(n)):
+                idx = np.array([self.head], np.int32)
+                row = TransitionBatch(*[np.asarray(v)[i:i + 1]
+                                        for v in frame])
+                # this IS the per-row anti-pattern (one H2D-carrying
+                # dispatch per transition), preserved as baseline/oracle
+                self._store.write(idx, row)
+                if self.trees is not None:
+                    self.trees = dper.insert_jitted(self.trees, idx,
+                                                    self.alpha)
+                self.head = int((self.head + 1) % self.capacity)
+                self.size = int(min(self.size + 1, self.capacity))
+            total += int(n)
+        return total
 
     def state_dict(self) -> dict:
         """Ring + tree state as host numpy for checkpointing. Learner

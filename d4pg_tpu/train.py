@@ -46,7 +46,7 @@ from d4pg_tpu.envs import (
 from d4pg_tpu.io import CheckpointManager, CsvLogger, MetricsBus, TensorBoardSink
 from d4pg_tpu.io.profiling import StepTimer, xla_trace
 from d4pg_tpu.learner import init_state, make_multi_update, make_update
-from d4pg_tpu.learner.pipeline import ChunkPipeline
+from d4pg_tpu.learner.pipeline import ChunkPipeline, IngestOverlap
 from d4pg_tpu.parallel import (
     MeshSpec,
     make_mesh,
@@ -741,22 +741,31 @@ def train(cfg: ExperimentConfig) -> dict:
     copy_params = jax.jit(
         lambda p: jax.tree_util.tree_map(jnp.copy, p))
 
+    ingest = IngestOverlap(service)
+
     def train_steps_fused(n: int):
-        """n fused updates. The only host work per chunk is draining staged
-        actor rows onto the device; dispatches run back-to-back with no
-        host round trip, so the learner never stalls on the tunnel."""
+        """n fused updates. The only host work per chunk is moving staged
+        actor rows onto the device, and that is overlapped: block t's
+        ring-write commits just before chunk t dispatches (async, no
+        transfer) and block t+1's single device_put rides under chunk t's
+        compute (learner/pipeline.IngestOverlap — ≤ 1 explicit H2D per
+        chunk), so the learner never stalls on the tunnel. The cycle
+        boundary still flushes everything: training each cycle sees all
+        rows the collect phase produced."""
         nonlocal state, lstep
         metrics = None
         done = 0
+        ingest.flush()
         while done < n:
             k = min(K, n - done)
             fn = fused_for(k)
-            service.drain_device()
+            ingest.commit()
             if cfg.prioritized_replay:
                 state, buffer.trees, metrics = fn(
                     state, buffer.trees, buffer.storage, buffer.size)
             else:
                 state, metrics = fn(state, buffer.storage, buffer.size)
+            ingest.stage()
             done += k
             lstep += k
             if cfg.async_actors:

@@ -279,8 +279,8 @@ def test_fused_chunk_uniform_variant(rng):
 
 def test_fused_buffer_drain_overflow_keeps_newest(rng):
     """Staging more rows than the ring holds must keep exactly the newest
-    ``capacity`` (one scatter with duplicate slots has an unspecified
-    winner, so overflow is trimmed before the write)."""
+    ``capacity`` (the block drain lands rows sequentially; older slots
+    are overwritten in order, never scatter-raced)."""
     buf = FusedDeviceReplay(CAP, 1, 1, prioritized=False)
     rows = np.arange(100, dtype=np.float32)[:, None]
     for lo in (0, 40):
@@ -291,16 +291,17 @@ def test_fused_buffer_drain_overflow_keeps_newest(rng):
             reward=r[:, 0], next_obs=r,
             done=np.zeros(n, np.float32),
             discount=np.ones(n, np.float32)))
-    assert buf.drain() == CAP
-    assert buf.size == CAP and buf.head == (100 - CAP + CAP) % CAP
-    got = np.sort(np.asarray(buf.storage.reward))
+    assert buf.drain() == 100  # all staged rows land (block-sequential)
+    assert buf.size == CAP and buf.head == 100 % CAP
+    got = np.sort(np.asarray(buf.storage.reward[:CAP]))
     np.testing.assert_array_equal(got, np.arange(100 - CAP, 100))
 
 
 def test_fused_buffer_staging_is_bounded(rng):
     """Ingest while the learner is paused must not grow without bound:
-    staged rows stay ~capacity (oldest dropped — the next drain would
-    overwrite them anyway), and drain still lands the newest rows."""
+    the preallocated staging ring drops the OLDEST rows under backlog
+    (the next drains would overwrite them anyway), and drain still lands
+    the newest rows."""
     buf = FusedDeviceReplay(CAP, 1, 1, prioritized=False)
     for i in range(20):  # 20 batches x 10 rows >> capacity 64
         r = np.full((10, 1), float(i), np.float32)
@@ -308,11 +309,12 @@ def test_fused_buffer_staging_is_bounded(rng):
             obs=r, action=np.zeros((10, 1), np.float32), reward=r[:, 0],
             next_obs=r, done=np.zeros(10, np.float32),
             discount=np.ones(10, np.float32)))
-    assert buf._staged_rows <= CAP + 10
+    assert len(buf._staging) <= buf._staging.size  # preallocated bound
+    assert buf._staging.size <= 2 * CAP  # stays O(capacity)
     buf.drain()
     assert buf.size == CAP
     # the newest batches survived
-    assert float(np.asarray(buf.storage.reward).max()) == 19.0
+    assert float(np.asarray(buf.storage.reward[:CAP]).max()) == 19.0
 
 
 def test_train_fused_uniform_async(tmp_path):

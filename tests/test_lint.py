@@ -298,11 +298,49 @@ def test_cli_exit_codes(tmp_path, capsys):
     assert lint_main([str(bad), "--rules", "no-such-rule"]) == 2
 
 
-def test_rule_catalog_covers_all_five_families():
+def test_rule_catalog_covers_all_families():
     assert set(RULES) == {
         "prng-key-reuse", "host-sync-in-jit", "recompile-hazard",
-        "use-after-donation", "tracer-leak",
+        "use-after-donation", "tracer-leak", "device-put-in-loop",
     }
+
+
+def test_device_put_in_loop_fires():
+    out = findings("""
+        import jax
+
+        def drain(rows):
+            for row in rows:
+                jax.device_put(row)
+
+        def drain_while(rows):
+            while rows:
+                x = jax.device_put(rows.pop())
+        """, "device-put-in-loop")
+    assert len(out) == 2
+
+
+def test_device_put_in_loop_clean_patterns():
+    out = findings("""
+        import jax
+
+        def block_drain(rows):
+            block = stack(rows)
+            return jax.device_put(block)  # one transfer, outside any loop
+
+        def other_scope(rows):
+            for row in rows:
+                # nested function is its own scope; defining it in a loop
+                # is not a per-iteration transfer
+                def put():
+                    return jax.device_put(row)
+            return put
+
+        def not_jax(rows, stager):
+            for row in rows:
+                stager.device_put(row)  # some other object's method
+        """, "device-put-in-loop")
+    assert out == []
 
 
 def test_syntax_error_reported_not_raised(tmp_path):

@@ -1,0 +1,108 @@
+"""Composition smoke: the conv-encoder pixel recipe executes under the
+{data, model} mesh (VERDICT r5 "What's missing" #1 — the pixel stack and
+the sharded fused replay plane had never run TOGETHER; the round-5
+share_encoder x K-scan double-donation bug was exactly this class of
+composition fault, caught only on the single-device path).
+
+Tiny shapes on the 8-virtual-CPU-device mesh: --share_encoder
+--frame_stack 3 --augment shift resolved through ExperimentConfig (the
+real flag path, including '--projection auto' resolving statically to
+einsum for mesh learners), uint8 pixel rows in the sharded device ring,
+one fused chunk through make_sharded_fused_chunk."""
+
+import jax
+import numpy as np
+import pytest
+
+from d4pg_tpu.config import ExperimentConfig
+from d4pg_tpu.learner import init_state
+from d4pg_tpu.learner.fused import make_sharded_fused_chunk
+from d4pg_tpu.parallel import MeshSpec, make_mesh
+from d4pg_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from d4pg_tpu.replay.sharded_per import ShardedFusedReplay
+from d4pg_tpu.replay.uniform import TransitionBatch
+
+SHAPE = (8, 8, 9)  # 8px frames, frame_stack=3 -> 3*3 stacked channels
+ACT = 2
+
+
+def _pixel_batch(rng, n):
+    return TransitionBatch(
+        obs=rng.integers(0, 255, (n, *SHAPE)).astype(np.uint8),
+        action=rng.uniform(-1, 1, (n, ACT)).astype(np.float32),
+        reward=rng.standard_normal(n).astype(np.float32),
+        next_obs=rng.integers(0, 255, (n, *SHAPE)).astype(np.uint8),
+        done=np.zeros(n, np.float32),
+        discount=np.full(n, 0.99, np.float32),
+    )
+
+
+def _pixel_config(dp):
+    cfg = ExperimentConfig(
+        env="pixel-point", share_encoder=True, frame_stack=3,
+        augment="shift", augment_pad=1, encoder_width=8, batch_size=16,
+        n_atoms=11, v_min=-10.0, v_max=10.0, hidden=(16, 16),
+        data_parallel=dp)
+    return cfg.learner_config(SHAPE, ACT)
+
+
+def test_pixel_share_encoder_fused_chunk_on_data_model_mesh(rng):
+    mesh = make_mesh(MeshSpec(data_parallel=4, model_parallel=2))
+    assert mesh.shape[DATA_AXIS] == 4 and mesh.shape[MODEL_AXIS] == 2
+    config = _pixel_config(dp=4)
+    assert config.pixels and config.share_encoder
+    assert config.augment == "shift"
+    # '--projection auto' must resolve STATICALLY to einsum under a mesh
+    # (the Pallas kernels have no GSPMD partitioning rule)
+    assert config.projection == "einsum"
+
+    buf = ShardedFusedReplay(64, SHAPE, ACT, mesh, alpha=0.6,
+                             obs_dtype=np.uint8)
+    buf.add(_pixel_batch(rng, 64))
+    buf.drain()
+    assert np.asarray(buf.storage.obs).dtype == np.uint8  # packed pixels
+
+    state = init_state(config, jax.random.key(0))
+    fn = make_sharded_fused_chunk(config, mesh, k=2, batch_size=16,
+                                  alpha=0.6, donate=False)
+    s1, t1, m = fn(state, buf.trees, buf.storage, buf.size)
+    assert int(jax.device_get(s1.step)) == 2
+    assert m["td_error"].shape == (2, 16)
+    for name in ("critic_loss", "actor_loss", "q_mean"):
+        assert np.isfinite(np.asarray(m[name])).all(), name
+    # the share_encoder tie must hold through the sharded chunk: the
+    # actor's conv encoder IS the critic's after every update
+    actor_enc = jax.device_get(s1.actor_params["params"]["encoder"])
+    critic_enc = jax.device_get(s1.critic_params["params"]["encoder"])
+    jax.tree_util.tree_map(np.testing.assert_array_equal,
+                           actor_enc, critic_enc)
+
+
+def test_pixel_mesh_chunk_matches_single_device_shapes(rng):
+    """The data-parallel pixel chunk and the single-device fused chunk
+    agree on metric/state structure (composition produces the same
+    training artifacts the single-device path does)."""
+    from d4pg_tpu.learner.fused import make_fused_chunk
+    from d4pg_tpu.replay.fused_buffer import FusedDeviceReplay
+
+    mesh = make_mesh(MeshSpec(data_parallel=2, model_parallel=1),
+                     devices=jax.devices()[:2])
+    config = _pixel_config(dp=2)
+    buf_m = ShardedFusedReplay(32, SHAPE, ACT, mesh, alpha=0.6,
+                               obs_dtype=np.uint8)
+    buf_s = FusedDeviceReplay(32, SHAPE, ACT, alpha=0.6,
+                              obs_dtype=np.uint8, block_rows=16)
+    batch = _pixel_batch(rng, 32)
+    for b in (buf_m, buf_s):
+        b.add(batch)
+        b.drain()
+    fn_m = make_sharded_fused_chunk(config, mesh, k=2, batch_size=16,
+                                    alpha=0.6, donate=False)
+    fn_s = make_fused_chunk(config, k=2, batch_size=16, alpha=0.6,
+                            donate=False)
+    state = init_state(config, jax.random.key(0))
+    _, _, m_m = fn_m(state, buf_m.trees, buf_m.storage, buf_m.size)
+    _, _, m_s = fn_s(state, buf_s.trees, buf_s.storage, buf_s.size)
+    assert m_m["td_error"].shape == m_s["td_error"].shape
+    assert np.isfinite(np.asarray(m_m["critic_loss"])).all()
+    assert np.isfinite(np.asarray(m_s["critic_loss"])).all()
