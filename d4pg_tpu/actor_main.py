@@ -36,10 +36,11 @@ class RemoteReplayClient:
             count_env_steps: bool = True) -> bool:
         # TCP provides ordering + backpressure. count_env_steps crosses the
         # wire as a frame flag so remote HER relabels don't inflate the
-        # learner's env-step counter.
+        # learner's env-step counter. Under --drop_on_timeout the sender
+        # sheds timed-out frames and returns False — the actor counts the
+        # loss (dropped_batches) and keeps acting instead of dying.
         del actor_id, block, timeout
-        self._sender.send(batch, count_env_steps=count_env_steps)
-        return True
+        return self._sender.send(batch, count_env_steps=count_env_steps)
 
 
 def run_actor(
@@ -50,6 +51,9 @@ def run_actor(
     actor_id: str = "remote-0",
     max_ticks: int | None = None,
     secret: str | None = None,
+    send_timeout: float = 300.0,
+    send_retries: int | None = None,
+    drop_on_timeout: bool = False,
 ) -> int:
     cfg = cfg.resolve()
     obs_dim, act_dim, obs_dtype = infer_dims(cfg)
@@ -57,9 +61,16 @@ def run_actor(
     # Block-coalescing transport (docs/architecture.md "Ingest plane"):
     # per-tick rows ride one frame per block instead of one frame per
     # send, with backpressure-aware block sizing. Episode boundaries and
-    # close() flush partial blocks.
+    # close() flush partial blocks. The fleet-degradation knobs
+    # (--send_timeout/--send_retries/--drop_on_timeout) bound how long a
+    # frame may retry and what happens at the bound: raise (default, a
+    # lone actor should fail loudly) or shed-and-count (a 256-actor fleet
+    # member should lose rows, not wedge).
     sender = CoalescingSender(learner_host, transitions_port,
-                              actor_id=actor_id, secret=secret)
+                              actor_id=actor_id, secret=secret,
+                              retry_timeout=send_timeout,
+                              max_retries=send_retries,
+                              drop_on_timeout=drop_on_timeout)
     weights = WeightClient(learner_host, weights_port, secret=secret)
     actor_cfg = ActorConfig(
         epsilon_0=cfg.epsilon_0, min_epsilon=cfg.min_epsilon,
@@ -107,6 +118,11 @@ def run_actor(
     except (KeyboardInterrupt, ConnectionError, BrokenPipeError, OSError) as e:
         print(f"actor {actor_id} stopping: {type(e).__name__}: {e}")
     finally:
+        if sender.frames_dropped or actor.dropped_batches:
+            # shed rows are benign but NEVER silent (fleet-plane rule)
+            print(f"actor {actor_id} shed {sender.frames_dropped} frames "
+                  f"({sender.retries} transport retries) under backpressure",
+                  flush=True)
         sender.close()
         weights.close()
         if pool is not None:
@@ -166,6 +182,15 @@ def main(argv=None):
     p.add_argument("--secret", default="",
                    help="shared secret matching the learner's --serve_secret")
     p.add_argument("--actor_device", choices=("cpu", "default"), default="cpu")
+    p.add_argument("--send_timeout", type=float, default=300.0,
+                   help="seconds a frame may retry across reconnects")
+    p.add_argument("--send_retries", type=int, default=None,
+                   help="max reconnect attempts per frame (default: "
+                        "unbounded within --send_timeout)")
+    p.add_argument("--drop_on_timeout", type=int, choices=(0, 1), default=0,
+                   help="1: shed timed-out frames (counted) and keep "
+                        "acting — the fleet-member policy; 0: raise and "
+                        "stop (default)")
     ns = p.parse_args(argv)
     if ns.actor_device == "cpu":
         # Acting runs on host CPU; force the platform BEFORE any jax call
@@ -181,7 +206,10 @@ def main(argv=None):
         actor_device=ns.actor_device)
     steps = run_actor(cfg, ns.learner_host, ns.transitions_port,
                       ns.weights_port, ns.actor_id, ns.max_ticks,
-                      secret=ns.secret or None)
+                      secret=ns.secret or None,
+                      send_timeout=ns.send_timeout,
+                      send_retries=ns.send_retries,
+                      drop_on_timeout=bool(ns.drop_on_timeout))
     print(f"collected {steps} env steps")
 
 

@@ -8,6 +8,14 @@ service + transition receiver + weight server, no learner) and counts
 arriving env steps over a fixed window:
 
     python -m d4pg_tpu.analysis.actor_scaling --procs 1 2 4 --seconds 10
+
+It also renders the FLEET scaling curve from a ``bench_fleet`` artifact
+(``python bench.py --fleet``, ``d4pg_tpu/fleet``) — rows/s vs N with p99
+send latency and the per-N loss/recovery counters, as a table and
+optionally a PNG:
+
+    python -m d4pg_tpu.analysis.actor_scaling \\
+        --fleet docs/evidence/fleet/fleet_<stamp>.json --plot fleet.png
 """
 
 from __future__ import annotations
@@ -165,6 +173,64 @@ def measure_budget(obs_dim: int = 376, act_dim: int = 17, rows: int = 8,
     return out
 
 
+def fleet_table(artifact: dict) -> str:
+    """Format a ``bench_fleet`` artifact (``fleet/sweep.py``) as the
+    actor-scaling table: rows/s vs N with latency, losses, recovery."""
+    header = (f"{'actors':>7} {'rows/s':>8} {'demand':>8} {'p50ms':>7} "
+              f"{'p99ms':>7} {'drops':>7} {'sheds':>6} {'retry':>6} "
+              f"{'crash':>6} {'readmit':>8} {'recov_s':>8}")
+    lines = [header]
+    for row in artifact["sweep"]:
+        lat = row["send_latency_ms"]
+        drops = row["drops"]
+        rec = row["recovery"]
+        lines.append(
+            f"{row['n_actors']:>7} {row['rows_per_sec']:>8,.0f} "
+            f"{row['demand_rows_per_sec']:>8,.0f} "
+            f"{lat['p50'] if lat['p50'] is not None else float('nan'):>7.2f} "
+            f"{lat['p99'] if lat['p99'] is not None else float('nan'):>7.2f} "
+            f"{drops['chaos_rows'] + drops['backpressure_rows']:>7} "
+            f"{drops['shed_rows']:>6} {row['retries']:>6} "
+            f"{row['crashes']:>6} {row['readmissions']:>8} "
+            + (f"{rec['mean_s']:>8.2f}" if rec["mean_s"] is not None
+               else f"{'—':>8}"))
+    return "\n".join(lines)
+
+
+def plot_fleet(artifact: dict, out_png: str) -> str:
+    """Rows/s-vs-N scaling curve (with the offered demand line) and p99
+    send latency on a twin axis; returns the written path."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    rows = artifact["sweep"]
+    n = [r["n_actors"] for r in rows]
+    rate = [r["rows_per_sec"] for r in rows]
+    demand = [r["demand_rows_per_sec"] for r in rows]
+    p99 = [r["send_latency_ms"]["p99"] for r in rows]
+    fig, ax = plt.subplots(figsize=(7, 4.2))
+    ax.plot(n, rate, "o-", label="ingested rows/s")
+    ax.plot(n, demand, "--", color="gray", label="offered demand")
+    ax.set_xscale("log", base=2)
+    ax.set_xticks(n, [str(v) for v in n])
+    ax.set_xlabel("actors (throttled sender lanes)")
+    ax.set_ylabel("rows/s into the replay service")
+    ax2 = ax.twinx()
+    ax2.plot(n, p99, "s:", color="tab:red", label="p99 send latency")
+    ax2.set_ylabel("p99 send latency (ms)")
+    h1, l1 = ax.get_legend_handles_labels()
+    h2, l2 = ax2.get_legend_handles_labels()
+    ax.legend(h1 + h2, l1 + l2, loc="upper left")
+    ax.set_title("Fleet plane scaling under chaos "
+                 f"(seed {artifact['config']['chaos']['seed']})")
+    fig.tight_layout()
+    fig.savefig(out_png, dpi=120)
+    plt.close(fig)
+    return out_png
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="d4pg_tpu.analysis.actor_scaling")
     ap.add_argument("--procs", type=int, nargs="+", default=[1, 2, 4])
@@ -176,7 +242,21 @@ def main(argv=None):
     ap.add_argument("--budget", action="store_true",
                     help="measure the per-component frame budget instead "
                          "of the scaling table")
+    ap.add_argument("--fleet", default=None, metavar="ARTIFACT_JSON",
+                    help="render the fleet scaling table from a "
+                         "bench_fleet artifact instead of measuring")
+    ap.add_argument("--plot", default=None, metavar="OUT_PNG",
+                    help="with --fleet: also write the scaling curve PNG")
     ns = ap.parse_args(argv)
+    if ns.fleet:
+        import json
+
+        with open(ns.fleet) as f:
+            artifact = json.load(f)
+        print(fleet_table(artifact))
+        if ns.plot:
+            print(f"wrote {plot_fleet(artifact, ns.plot)}")
+        return
     if ns.budget:
         budget = measure_budget()
         for key, val in budget.items():

@@ -21,6 +21,7 @@ from d4pg_tpu.distributed.replay_service import ReplayService
 from d4pg_tpu.distributed.actor import ActorConfig, ActorWorker
 from d4pg_tpu.distributed.evaluator import AsyncEvaluator, Evaluator
 from d4pg_tpu.distributed.transport import (
+    CoalescingSender,
     TransitionReceiver,
     TransitionSender,
 )
@@ -32,6 +33,7 @@ __all__ = [
     "ActorWorker",
     "AsyncEvaluator",
     "Evaluator",
+    "CoalescingSender",
     "TransitionReceiver",
     "TransitionSender",
 ]

@@ -146,6 +146,12 @@ class _BaseActor:
         self._ou = None  # lazily-sized OU state when cfg.noise == 'ou'
         self._stop = threading.Event()
         self.env_steps = 0
+        # Degradation accounting: ``service.add`` returning False (ingest
+        # backpressure past its timeout) or a drop_on_timeout transport
+        # shedding a frame means replay rows were LOST — benign for
+        # ingest, but it must be a counted, surfaced event (the fleet
+        # plane's no-silent-loss rule), never a crash or a silent pass.
+        self.dropped_batches = 0
 
     def _device_scope(self):
         """Context placing this actor's jax dispatches on its pinned device
@@ -280,7 +286,8 @@ class ActorWorker(_BaseActor):
                 obs, actions, out.reward * self.cfg.reward_scale,
                 out.final_obs, out.terminated, out.truncated,
             )
-            self.service.add(folded, actor_id=self.actor_id)
+            if not self.service.add(folded, actor_id=self.actor_id):
+                self.dropped_batches += 1
             done_any = out.terminated | out.truncated
             self._reset_noise(done_any)
             for _ in range(int(done_any.sum())):
@@ -385,11 +392,13 @@ class GoalActorWorker(_BaseActor):
         # insert (and folds them into the statistics — original AND
         # relabeled rows are what the networks train on, so goal dims get
         # stats from desired and achieved goals alike)
-        self.service.add(originals, actor_id=self.actor_id)
+        if not self.service.add(originals, actor_id=self.actor_id):
+            self.dropped_batches += 1
         # relabels are synthetic rows, not fresh env interaction: keep them
         # out of the env_steps counter (it is logged and checkpointed)
-        self.service.add(relabeled, actor_id=self.actor_id,
-                         count_env_steps=False)
+        if not self.service.add(relabeled, actor_id=self.actor_id,
+                                count_env_steps=False):
+            self.dropped_batches += 1
         self._reset_noise(np.array([True]))  # episode boundary: zero OU state
         self._decay_epsilon()
         return T

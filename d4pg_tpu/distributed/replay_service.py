@@ -28,7 +28,16 @@ class ReplayService:
         ingest_capacity: int = 256,
         heartbeat_timeout: float = 30.0,
         obs_norm=None,
+        shed_watermark: float | None = None,
     ):
+        """``shed_watermark`` (fraction of ``ingest_capacity``, fleet-plane
+        degradation): when the ingest queue stands at or above the
+        watermark, ``add`` sheds the OLDEST queued batch to admit the
+        newest instead of blocking the caller — a stalled drain degrades
+        the replay distribution (newest-biased, counted in ``sheds``/
+        ``shed_rows``) rather than wedging 256 receiver threads. None
+        (default) keeps the block-or-False contract of the training
+        loop."""
         self.buffer = buffer
         # Optional RunningMeanStd (envs/normalizer.py). The drain thread is
         # the SINGLE writer: it folds every ingested row (local, spawned or
@@ -50,6 +59,20 @@ class ReplayService:
         self._pending = 0
         self._heartbeats: dict[str, float] = {}
         self._heartbeat_timeout = heartbeat_timeout
+        # Fleet-plane degradation + recovery state (all under self._lock):
+        # evicted actors are remembered so a resumed heartbeat RE-ADMITS
+        # them (and records the outage length) instead of counting them
+        # dead forever; shed counters surface every dropped batch.
+        self._shed_at = (
+            None if shed_watermark is None
+            else max(1, min(ingest_capacity,
+                            int(shed_watermark * ingest_capacity))))
+        self._evicted: dict[str, float] = {}
+        self._recovery_s: list[float] = []
+        self.sheds = 0
+        self.shed_rows = 0
+        self.evictions = 0
+        self.readmissions = 0
         self._stop = threading.Event()
         self._drain_thread = threading.Thread(target=self._drain, daemon=True)
         self._drain_thread.start()
@@ -63,24 +86,56 @@ class ReplayService:
 
         ``count_env_steps=False`` for rows that do not correspond to fresh
         environment interaction (HER relabels) — otherwise the env_steps
-        counter inflates by (1 + her_ratio)x in HER runs."""
+        counter inflates by (1 + her_ratio)x in HER runs.
+
+        With a ``shed_watermark`` configured, ``add`` NEVER blocks: a
+        queue at the watermark sheds its oldest batch (counted) to admit
+        this one, and the call returns True."""
         self.heartbeat(actor_id)
         if batch.obs.shape[0] == 0:
             return True
         with self._lock:
             self._pending += 1
+        item = (actor_id, batch, count_env_steps)
+        if self._shed_at is not None:
+            return self._put_shedding(item)
         try:
-            self._queue.put((actor_id, batch, count_env_steps),
-                            block=block, timeout=timeout)
+            self._queue.put(item, block=block, timeout=timeout)
             return True
         except queue.Full:
             with self._lock:
                 self._pending -= 1
             return False
 
+    def _put_shedding(self, item) -> bool:
+        """Admit ``item``, shedding the oldest queued batch while the queue
+        stands at/above the watermark — bounded work, never blocks."""
+        while True:
+            if self._queue.qsize() < self._shed_at:
+                try:
+                    self._queue.put_nowait(item)
+                    return True
+                except queue.Full:
+                    pass  # racing producers filled it; fall through to shed
+            try:
+                _aid, old_batch, _cnt = self._queue.get_nowait()
+            except queue.Empty:
+                continue  # the drain thread beat us to it; retry the put
+            with self._lock:
+                self.sheds += 1
+                self.shed_rows += old_batch.obs.shape[0]
+                self._pending -= 1  # shed batches never reach the drain
+
     def heartbeat(self, actor_id: str) -> None:
+        now = time.monotonic()
         with self._lock:
-            self._heartbeats[actor_id] = time.monotonic()
+            evicted_at = self._evicted.pop(actor_id, None)
+            if evicted_at is not None:
+                # the actor came back: re-admit and record the outage
+                self.readmissions += 1
+                if len(self._recovery_s) < 10_000:
+                    self._recovery_s.append(now - evicted_at)
+            self._heartbeats[actor_id] = now
 
     # -- learner-facing ----------------------------------------------------
     def sample(self, batch_size: int, beta: float = 0.4,
@@ -202,13 +257,57 @@ class ReplayService:
         return True
 
     def dead_actors(self) -> list[str]:
-        """Actors whose last heartbeat exceeded the timeout."""
+        """Actors currently considered dead: heartbeat-stale ones plus the
+        evicted-and-not-yet-returned set. An evicted actor that resumes
+        heartbeating (or streaming — ``add`` heartbeats) is RE-ADMITTED by
+        ``heartbeat`` and drops out of this list; before that fix an
+        eviction was permanent and a restarted actor with the same id
+        stayed counted dead forever."""
         now = time.monotonic()
         with self._lock:
-            return [
+            stale = [
                 a for a, t in self._heartbeats.items()
                 if now - t > self._heartbeat_timeout
             ]
+            return stale + [a for a in self._evicted if a not in stale]
+
+    def evict_dead(self) -> list[str]:
+        """Move heartbeat-stale actors into the evicted set (their next
+        heartbeat re-admits them and records the outage as a recovery
+        sample). Returns the newly evicted ids. Called periodically by the
+        fleet monitor; idempotent between actor state changes."""
+        now = time.monotonic()
+        with self._lock:
+            stale = [
+                a for a, t in self._heartbeats.items()
+                if now - t > self._heartbeat_timeout
+            ]
+            for a in stale:
+                del self._heartbeats[a]
+                self._evicted[a] = now
+                self.evictions += 1
+            return stale
+
+    def evicted_actors(self) -> list[str]:
+        with self._lock:
+            return list(self._evicted)
+
+    def ingest_stats(self) -> dict:
+        """Degradation/recovery counters for the fleet plane: sheds,
+        evictions, re-admissions, recovery times, live queue depth."""
+        with self._lock:
+            return {
+                "env_steps": self._env_steps,
+                "pending": self._pending,
+                "queue_depth": self._queue.qsize(),
+                "sheds": self.sheds,
+                "shed_rows": self.shed_rows,
+                "evictions": self.evictions,
+                "readmissions": self.readmissions,
+                "recovery_s": list(self._recovery_s),
+                "live_actors": len(self._heartbeats),
+                "evicted": len(self._evicted),
+            }
 
     # -- internals ---------------------------------------------------------
     # Max batches folded into one coalesced insert pass: bounds the lock

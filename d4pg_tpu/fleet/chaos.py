@@ -1,0 +1,150 @@
+"""Seeded fault injection for the fleet plane.
+
+The BASELINE fleet is 256 actors; at that fan-out transient failures are
+the steady state, not the exception (Adamski et al., arXiv:1801.02852:
+stragglers and restarts dominate wall-clock once fleets are wide). The
+stress harness therefore injects faults ON PURPOSE, from a seeded policy,
+so every degradation path in the transport/ingest stack is exercised
+deterministically:
+
+  - ``drop``  — a block vanishes at the transport boundary (lossy DCN);
+  - ``delay`` — a block is delivered late with uniform jitter (straggler);
+  - ``crash`` — the actor dies abruptly (no flush, no goodbye) and
+    restarts after a fixed downtime (preemption / OOM kill);
+  - receiver stalls — the learner-side ingest callback freezes for a
+    window (GC pause, checkpoint write, learner restart).
+
+Determinism contract: decision ``i`` of actor ``k`` depends ONLY on
+``(ChaosConfig.seed, k, i)`` — never on wall clock or thread interleaving
+— so a seeded fleet run replays the same fault script bit-for-bit at the
+harness level (the acceptance bar for reproducible chaos runs). Each
+decision consumes exactly ``DRAWS_PER_EVENT`` uniforms from a
+``SeedSequence``-derived per-actor stream, which keeps the event index
+aligned with the RNG state no matter which faults fire.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import NamedTuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """Fault probabilities are PER DECISION POINT (one sender block)."""
+
+    drop_prob: float = 0.0
+    delay_prob: float = 0.0
+    delay_min_s: float = 0.0
+    delay_max_s: float = 0.05
+    crash_prob: float = 0.0
+    restart_delay_s: float = 0.5
+    # Receiver stalls run on a fixed schedule rather than a probability:
+    # every ``stall_every_s`` of harness time the ingest callback freezes
+    # for ``receiver_stall_s`` (0 for either disables stalls).
+    receiver_stall_s: float = 0.0
+    stall_every_s: float = 0.0
+    seed: int = 0
+
+    def __post_init__(self):
+        for name in ("drop_prob", "delay_prob", "crash_prob"):
+            p = getattr(self, name)
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"{name}={p} outside [0, 1]")
+        if self.delay_max_s < self.delay_min_s:
+            raise ValueError("delay_max_s < delay_min_s")
+
+    def enabled(self) -> bool:
+        return (self.drop_prob > 0 or self.delay_prob > 0
+                or self.crash_prob > 0
+                or (self.receiver_stall_s > 0 and self.stall_every_s > 0))
+
+
+class ChaosEvent(NamedTuple):
+    actor_id: str
+    index: int
+    kind: str  # 'ok' | 'drop' | 'delay' | 'crash'
+    arg: float  # delay seconds / restart downtime; 0.0 otherwise
+
+
+# Uniforms consumed per decision: (crash, drop, delay, delay-jitter). A
+# FIXED draw count per event keeps actor streams index-stable: event i is
+# the same regardless of which faults fired before it.
+DRAWS_PER_EVENT = 4
+
+
+class ActorChaos:
+    """One actor's deterministic fault stream (+ its event log)."""
+
+    def __init__(self, config: ChaosConfig, actor_index: int, actor_id: str):
+        self.config = config
+        self.actor_id = actor_id
+        self._rng = np.random.default_rng(
+            np.random.SeedSequence(config.seed, spawn_key=(actor_index,)))
+        self.log: list[ChaosEvent] = []
+        self._i = 0
+
+    def next(self) -> ChaosEvent:
+        u_crash, u_drop, u_delay, u_jit = self._rng.random(DRAWS_PER_EVENT)
+        cfg = self.config
+        if u_crash < cfg.crash_prob:
+            kind, arg = "crash", cfg.restart_delay_s
+        elif u_drop < cfg.drop_prob:
+            kind, arg = "drop", 0.0
+        elif u_delay < cfg.delay_prob:
+            kind = "delay"
+            arg = cfg.delay_min_s + u_jit * (cfg.delay_max_s - cfg.delay_min_s)
+        else:
+            kind, arg = "ok", 0.0
+        ev = ChaosEvent(self.actor_id, self._i, kind, float(arg))
+        self._i += 1
+        self.log.append(ev)
+        return ev
+
+
+class ChaosPolicy:
+    """Factory for per-actor fault streams and the receiver-stall script."""
+
+    def __init__(self, config: ChaosConfig):
+        self.config = config
+
+    def actor_stream(self, actor_index: int, actor_id: str) -> ActorChaos:
+        return ActorChaos(self.config, actor_index, actor_id)
+
+    def stall_schedule(self, horizon_s: float) -> list[tuple[float, float]]:
+        """Deterministic ``(start_offset_s, duration_s)`` receiver stalls
+        within ``horizon_s`` of harness time."""
+        cfg = self.config
+        if cfg.stall_every_s <= 0 or cfg.receiver_stall_s <= 0:
+            return []
+        out, t = [], cfg.stall_every_s
+        while t < horizon_s:
+            out.append((t, cfg.receiver_stall_s))
+            t += cfg.stall_every_s + cfg.receiver_stall_s
+        return out
+
+
+class StallGate:
+    """The receiver-stall injection point: the ingest callback passes
+    through ``wait()``; the stall controller closes/opens the gate. Waits
+    are BOUNDED so a stall can never be mistaken for a receiver deadlock
+    — a gated callback resumes the moment the gate opens or the bound
+    elapses."""
+
+    def __init__(self):
+        self._open = threading.Event()
+        self._open.set()
+        self.stalls = 0
+
+    def stall(self) -> None:
+        self.stalls += 1
+        self._open.clear()
+
+    def resume(self) -> None:
+        self._open.set()
+
+    def wait(self, timeout: float = 30.0) -> bool:
+        return self._open.wait(timeout)

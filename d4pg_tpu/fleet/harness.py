@@ -1,0 +1,317 @@
+"""The fleet harness: N chaos-wrapped sender lanes vs ONE replay service.
+
+This is the measurement rig that closes the ROADMAP fan-out item: BASELINE
+mandates 256 actors, PR 2 priced the ingest plane at ~5,200 Humanoid rows/s
+per receiver core, and this harness actually RUNS the fan-out — real TCP,
+real frames, seeded faults — and reports what the plane does under it:
+
+  - rows/s actually inserted (the number the priced ceiling predicted),
+  - p50/p99 send latency across every lane,
+  - every loss, named: chaos drops, backpressure drops (sender-side
+    timeout sheds), receiver sheds (oldest-batch watermark evictions),
+  - recovery: crash→first-delivered-block per lane, and the service's own
+    eviction→re-admission intervals,
+  - a deadlock verdict (all lanes joined, drain alive, queue drained).
+
+Lanes are threads by default (a 256-lane fleet on one host); ``mode=
+'process'`` spawns real subprocesses for small-N cross-checks. Chaos is
+seeded and index-deterministic (``fleet/chaos.py``), so a run's fault
+script — which lane dropped/delayed/crashed at which tick — replays
+bit-for-bit; use ``max_ticks`` (instead of ``duration_s``) to make two
+runs' scripts comparable end-to-end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+
+from d4pg_tpu.distributed.replay_service import ReplayService
+from d4pg_tpu.distributed.transport import TransitionReceiver
+from d4pg_tpu.fleet.chaos import ChaosConfig, ChaosPolicy, StallGate
+from d4pg_tpu.fleet.sender import ThrottledSender, synthetic_block
+from d4pg_tpu.replay.uniform import ReplayBuffer
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    n_actors: int = 8
+    duration_s: float = 8.0
+    # when set, every lane runs EXACTLY this many ticks and duration_s is
+    # ignored — the deterministic mode (chaos scripts align 1:1 across runs)
+    max_ticks: int | None = None
+    rows_per_sec: float = 20.0  # per-lane offered load
+    block_rows: int = 16
+    obs_dim: int = 376  # Humanoid-sized rows: comparable to the priced plane
+    act_dim: int = 17
+    capacity: int = 100_000
+    ingest_capacity: int = 64
+    shed_watermark: float = 0.75
+    heartbeat_timeout: float = 3.0
+    evict_every_s: float = 0.5
+    send_timeout: float = 1.0
+    max_retries: int | None = 4
+    mode: str = "thread"  # 'thread' | 'process'
+    chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
+    template_seed: int = 0
+    connect_stagger_s: float = 0.002  # per-lane offset on the connect storm
+
+    def __post_init__(self):
+        if self.mode not in ("thread", "process"):
+            raise ValueError(f"unknown fleet mode {self.mode!r}")
+
+    def demand_rows_per_sec(self) -> float:
+        return self.n_actors * self.rows_per_sec
+
+
+def _quiesce(service: ReplayService, settle_s: float = 0.25,
+             timeout: float = 5.0) -> None:
+    """Wait for the in-flight tail: lanes have closed their sockets, but
+    their final frames can still be in kernel buffers / receiver threads.
+    Returns once the insert counter stops moving for ``settle_s`` (so the
+    accounting the report does is over a drained plane), bounded by
+    ``timeout``."""
+    deadline = time.monotonic() + timeout
+    last = service.env_steps
+    last_change = time.monotonic()
+    while time.monotonic() < deadline:
+        time.sleep(0.05)
+        now_steps = service.env_steps
+        if now_steps != last:
+            last, last_change = now_steps, time.monotonic()
+        elif time.monotonic() - last_change >= settle_s:
+            return
+
+
+def _percentiles(values: list[float]) -> dict:
+    if not values:
+        return {"p50": None, "p99": None, "mean": None, "n": 0}
+    arr = np.asarray(values, np.float64)
+    return {
+        "p50": round(float(np.percentile(arr, 50)), 3),
+        "p99": round(float(np.percentile(arr, 99)), 3),
+        "mean": round(float(arr.mean()), 3),
+        "n": int(arr.size),
+    }
+
+
+def _recovery_stats(samples: list[float]) -> dict:
+    if not samples:
+        return {"mean_s": None, "max_s": None, "n": 0}
+    arr = np.asarray(samples, np.float64)
+    return {
+        "mean_s": round(float(arr.mean()), 3),
+        "max_s": round(float(arr.max()), 3),
+        "n": int(arr.size),
+    }
+
+
+class FleetHarness:
+    def __init__(self, config: FleetConfig):
+        self.config = config
+        self.policy = ChaosPolicy(config.chaos)
+
+    # -- thread mode -------------------------------------------------------
+    def run(self) -> dict:
+        cfg = self.config
+        if cfg.mode == "process":
+            return self._run_processes()
+        service = ReplayService(
+            ReplayBuffer(cfg.capacity, cfg.obs_dim, cfg.act_dim),
+            ingest_capacity=cfg.ingest_capacity,
+            heartbeat_timeout=cfg.heartbeat_timeout,
+            shed_watermark=cfg.shed_watermark,
+        )
+        gate = StallGate()
+
+        def on_batch(batch, actor_id, count):
+            gate.wait()
+            service.add(batch, actor_id=actor_id, block=False,
+                        count_env_steps=count)
+
+        receiver = TransitionReceiver(on_batch, host="127.0.0.1")
+        template = synthetic_block(cfg.block_rows, cfg.obs_dim, cfg.act_dim,
+                                   seed=cfg.template_seed)
+        stop = threading.Event()
+        lanes = [
+            ThrottledSender(
+                i, f"fleet-{i}", "127.0.0.1", receiver.port, template,
+                self.policy.actor_stream(i, f"fleet-{i}"),
+                rows_per_sec=cfg.rows_per_sec,
+                send_timeout=cfg.send_timeout, max_retries=cfg.max_retries,
+                max_ticks=cfg.max_ticks, stop=stop,
+                connect_stagger_s=i * cfg.connect_stagger_s,
+            )
+            for i in range(cfg.n_actors)
+        ]
+        threads = [
+            threading.Thread(target=lane.run, daemon=True,
+                             name=f"fleet-lane-{i}")
+            for i, lane in enumerate(lanes)
+        ]
+
+        monitor_stop = threading.Event()
+
+        def monitor():
+            # periodic heartbeat eviction + the seeded receiver-stall script
+            horizon = cfg.duration_s if cfg.max_ticks is None else 3600.0
+            stalls = list(self.policy.stall_schedule(horizon))
+            t0 = time.monotonic()
+            while not monitor_stop.is_set():
+                service.evict_dead()
+                now = time.monotonic() - t0
+                if stalls and now >= stalls[0][0]:
+                    _, dur = stalls.pop(0)
+                    gate.stall()
+                    monitor_stop.wait(dur)
+                    gate.resume()
+                monitor_stop.wait(cfg.evict_every_s)
+
+        monitor_thread = threading.Thread(target=monitor, daemon=True)
+
+        t_start = time.monotonic()
+        steps0 = service.env_steps
+        for t in threads:
+            t.start()
+        monitor_thread.start()
+
+        deadlocks = 0
+        if cfg.max_ticks is not None:
+            # deterministic mode: lanes exit on their own tick budget
+            budget = (cfg.max_ticks
+                      * (cfg.block_rows / cfg.rows_per_sec + cfg.send_timeout)
+                      + 10 * (cfg.chaos.restart_delay_s + 1.0) + 30.0)
+            for t in threads:
+                t.join(timeout=max(0.0, budget - (time.monotonic() - t_start)))
+        else:
+            stop.wait(cfg.duration_s)
+            stop.set()
+            for t in threads:
+                t.join(timeout=cfg.send_timeout + 10.0)
+        stop.set()
+        deadlocks += sum(t.is_alive() for t in threads)
+        dt = time.monotonic() - t_start
+
+        gate.resume()  # never leave the drain gated during teardown
+        monitor_stop.set()
+        monitor_thread.join(timeout=5.0)
+        _quiesce(service)
+        receiver.close()
+        service.flush(timeout=10.0)
+        rows_inserted = service.env_steps - steps0
+        stats = service.ingest_stats()
+        if stats["pending"] > 0 or not service._drain_thread.is_alive():
+            deadlocks += 1  # drain wedged with accepted batches in flight
+        service.close()
+
+        return self._report(lanes=[lane.summary() for lane in lanes],
+                            rows_inserted=rows_inserted, dt=dt,
+                            service_stats=stats, deadlocks=deadlocks,
+                            stalls=gate.stalls)
+
+    # -- process mode ------------------------------------------------------
+    def _run_processes(self) -> dict:
+        import multiprocessing as mp
+
+        from d4pg_tpu.fleet.sender import _process_lane_main
+
+        cfg = self.config
+        service = ReplayService(
+            ReplayBuffer(cfg.capacity, cfg.obs_dim, cfg.act_dim),
+            ingest_capacity=cfg.ingest_capacity,
+            heartbeat_timeout=cfg.heartbeat_timeout,
+            shed_watermark=cfg.shed_watermark,
+        )
+        receiver = TransitionReceiver(
+            lambda b, aid, count: service.add(b, actor_id=aid, block=False,
+                                              count_env_steps=count),
+            host="127.0.0.1")
+        ctx = mp.get_context("spawn")
+        out_q = ctx.Queue()
+        duration = (cfg.duration_s if cfg.max_ticks is None
+                    else cfg.max_ticks * cfg.block_rows / cfg.rows_per_sec
+                    + 30.0)
+        procs = []
+        for i in range(cfg.n_actors):
+            kwargs = {
+                "actor_index": i, "actor_id": f"fleet-{i}",
+                "host": "127.0.0.1", "port": receiver.port,
+                "chaos_config": dataclasses.asdict(cfg.chaos),
+                "block_rows": cfg.block_rows, "obs_dim": cfg.obs_dim,
+                "act_dim": cfg.act_dim, "template_seed": cfg.template_seed,
+                "rows_per_sec": cfg.rows_per_sec,
+                "send_timeout": cfg.send_timeout,
+                "max_retries": cfg.max_retries, "max_ticks": cfg.max_ticks,
+                "connect_stagger_s": i * cfg.connect_stagger_s,
+            }
+            p = ctx.Process(target=_process_lane_main,
+                            args=(kwargs, duration, out_q), daemon=True)
+            p.start()
+            procs.append(p)
+        t_start = time.monotonic()
+        steps0 = service.env_steps
+        summaries, deadlocks = [], 0
+        for _ in procs:
+            try:
+                summaries.append(out_q.get(timeout=duration + 60.0))
+            except Exception:
+                deadlocks += 1
+        for p in procs:
+            p.join(timeout=10.0)
+            if p.is_alive():
+                p.terminate()
+        dt = time.monotonic() - t_start
+        _quiesce(service)
+        receiver.close()
+        service.flush(timeout=10.0)
+        rows_inserted = service.env_steps - steps0
+        stats = service.ingest_stats()
+        service.close()
+        return self._report(lanes=summaries, rows_inserted=rows_inserted,
+                            dt=dt, service_stats=stats, deadlocks=deadlocks,
+                            stalls=0)
+
+    # -- artifact ----------------------------------------------------------
+    def _report(self, lanes: list[dict], rows_inserted: int, dt: float,
+                service_stats: dict, deadlocks: int, stalls: int) -> dict:
+        cfg = self.config
+        latencies = [v for lane in lanes for v in lane["latencies_ms"]]
+        lane_recovery = [v for lane in lanes for v in lane["recovery_s"]]
+        attempted = sum(lane["rows_attempted"] for lane in lanes)
+        return {
+            "n_actors": cfg.n_actors,
+            "mode": cfg.mode,
+            "duration_s": round(dt, 3),
+            "rows_per_sec": round(rows_inserted / dt, 1) if dt else 0.0,
+            "demand_rows_per_sec": round(cfg.demand_rows_per_sec(), 1),
+            "rows_inserted": int(rows_inserted),
+            "rows_attempted": int(attempted),
+            "delivery_ratio": (round(rows_inserted / attempted, 4)
+                               if attempted else None),
+            "send_latency_ms": _percentiles(latencies),
+            "drops": {
+                "chaos_rows": sum(lane["rows_dropped_chaos"]
+                                  for lane in lanes),
+                "backpressure_rows": sum(
+                    lane["rows_dropped_backpressure"] for lane in lanes),
+                "shed_batches": service_stats["sheds"],
+                "shed_rows": service_stats["shed_rows"],
+            },
+            "retries": sum(lane["retries"] for lane in lanes),
+            "crashes": sum(lane["crashes"] for lane in lanes),
+            "failed_restarts": sum(lane["failed_restarts"] for lane in lanes),
+            "recovery": _recovery_stats(lane_recovery),
+            "evictions": service_stats["evictions"],
+            "readmissions": service_stats["readmissions"],
+            "service_recovery": _recovery_stats(service_stats["recovery_s"]),
+            "receiver_stalls": stalls,
+            "deadlocks": deadlocks,
+            "ticks": sum(lane["ticks"] for lane in lanes),
+            "chaos": dataclasses.asdict(cfg.chaos),
+            "seed": cfg.chaos.seed,
+            "chaos_log": sorted(
+                ev for lane in lanes for ev in lane["chaos_log"]),
+        }

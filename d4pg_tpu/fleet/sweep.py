@@ -1,0 +1,107 @@
+"""Fleet fan-out sweep: rows/s vs N actors, chaos on, one receiver.
+
+The BASELINE-closing measurement (ROADMAP "Fan-out above 8 actors"):
+N ∈ {8, 32, 64, 128, 256} throttled lanes at fixed per-lane demand, so
+the sweep walks the plane from idle (8 × 20 = 160 rows/s) through the
+priced ~5,200 rows/s/core ceiling (256 × 20 = 5,120 rows/s) with the
+default chaos mix injecting drops, stragglers, crashes and receiver
+stalls the whole way. Run it:
+
+    python -m d4pg_tpu.fleet.sweep --ns 8 32 64 128 256 --seconds 10
+    python bench.py --fleet           # same sweep, persisted artifact
+
+Per-N rows of the artifact are ``FleetHarness._report`` dicts minus the
+raw chaos log (the log is deterministic from the seed — regenerate it by
+re-running; the artifact carries the seed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+
+from d4pg_tpu.fleet.chaos import ChaosConfig
+from d4pg_tpu.fleet.harness import FleetConfig, FleetHarness
+
+SWEEP_NS = (8, 32, 64, 128, 256)
+
+
+def default_chaos(seed: int = 0) -> ChaosConfig:
+    """The sweep's standard fault mix: ~2% dropped blocks, ~5% stragglers
+    (5-50 ms), ~0.4%/tick crashes with a 4 s outage (long enough to cross
+    the 3 s heartbeat timeout, so every crash exercises eviction AND
+    re-admission), and a 0.5 s receiver stall every 3 s."""
+    return ChaosConfig(
+        drop_prob=0.02,
+        delay_prob=0.05, delay_min_s=0.005, delay_max_s=0.05,
+        crash_prob=0.004, restart_delay_s=4.0,
+        receiver_stall_s=0.5, stall_every_s=3.0,
+        seed=seed,
+    )
+
+
+def run_sweep(
+    ns=SWEEP_NS,
+    duration_s: float = 10.0,
+    chaos: ChaosConfig | None = None,
+    **overrides,
+) -> dict:
+    """Run the fleet harness at each N; returns the bench_fleet artifact."""
+    chaos = default_chaos() if chaos is None else chaos
+    rows = []
+    for n in ns:
+        cfg = FleetConfig(n_actors=int(n), duration_s=duration_s,
+                          chaos=chaos, **overrides)
+        result = FleetHarness(cfg).run()
+        result.pop("chaos_log", None)  # deterministic from the seed
+        rows.append(result)
+    base = FleetConfig(chaos=chaos, **overrides)
+    return {
+        "metric": "fleet_rows_per_sec",
+        "unit": "rows/sec",
+        "schema": 1,
+        "sweep": rows,
+        "config": {
+            "rows_per_sec_per_actor": base.rows_per_sec,
+            "block_rows": base.block_rows,
+            "obs_dim": base.obs_dim,
+            "act_dim": base.act_dim,
+            "ingest_capacity": base.ingest_capacity,
+            "shed_watermark": base.shed_watermark,
+            "heartbeat_timeout": base.heartbeat_timeout,
+            "send_timeout": base.send_timeout,
+            "max_retries": base.max_retries,
+            "mode": base.mode,
+            "chaos": dataclasses.asdict(chaos),
+        },
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="d4pg_tpu.fleet.sweep")
+    ap.add_argument("--ns", type=int, nargs="+", default=list(SWEEP_NS))
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--rows_per_sec", type=float, default=20.0)
+    ap.add_argument("--block_rows", type=int, default=16)
+    ap.add_argument("--mode", choices=("thread", "process"),
+                    default="thread")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no_chaos", action="store_true",
+                    help="clean-plane control run (all fault probs 0)")
+    ap.add_argument("--out", default=None,
+                    help="also write the artifact JSON to this path")
+    ns = ap.parse_args(argv)
+    chaos = (ChaosConfig(seed=ns.seed) if ns.no_chaos
+             else default_chaos(ns.seed))
+    artifact = run_sweep(ns=tuple(ns.ns), duration_s=ns.seconds,
+                         chaos=chaos, rows_per_sec=ns.rows_per_sec,
+                         block_rows=ns.block_rows, mode=ns.mode)
+    if ns.out:
+        with open(ns.out, "w") as f:
+            json.dump(artifact, f, indent=2)
+    print(json.dumps(artifact))
+
+
+if __name__ == "__main__":
+    main()

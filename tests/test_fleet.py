@@ -1,0 +1,245 @@
+"""Fleet-plane tests: the chaos harness itself.
+
+Tier-1 scope: an N=8 chaos-enabled smoke (seeded, seconds), bit-for-bit
+reproducibility of the seeded fault script, determinism of the chaos
+primitives, and the degradation bookkeeping (every lost row lands in a
+named counter). The wide sweeps (N up to 256) are ``slow``; their real
+run is the committed ``docs/evidence/fleet/`` artifact from
+``python bench.py --fleet``.
+"""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.fleet import (
+    ActorChaos,
+    ChaosConfig,
+    ChaosPolicy,
+    FleetConfig,
+    FleetHarness,
+    StallGate,
+    run_sweep,
+    synthetic_block,
+)
+
+# The tier-1 chaos mix: every fault kind enabled, scaled so an N=8 x
+# 12-tick run still exercises drops, delays, crashes AND the stall gate.
+SMOKE_CHAOS = ChaosConfig(
+    drop_prob=0.1,
+    delay_prob=0.2, delay_min_s=0.001, delay_max_s=0.005,
+    crash_prob=0.05, restart_delay_s=0.3,
+    receiver_stall_s=0.1, stall_every_s=0.4,
+    seed=7,
+)
+
+
+def _smoke_config(**overrides) -> FleetConfig:
+    base = dict(
+        n_actors=8, max_ticks=12, rows_per_sec=400.0, block_rows=16,
+        obs_dim=24, act_dim=4, capacity=20_000, heartbeat_timeout=0.5,
+        evict_every_s=0.1, send_timeout=0.5, chaos=SMOKE_CHAOS,
+    )
+    base.update(overrides)
+    return FleetConfig(**base)
+
+
+def test_chaos_stream_deterministic():
+    """Decision i of actor k depends only on (seed, k, i): two streams
+    built from the same config replay the identical fault script, and a
+    different actor index yields a different (decorrelated) one."""
+    a = ActorChaos(SMOKE_CHAOS, 3, "a3")
+    b = ActorChaos(SMOKE_CHAOS, 3, "a3")
+    other = ActorChaos(SMOKE_CHAOS, 4, "a3")
+    seq_a = [a.next() for _ in range(200)]
+    seq_b = [b.next() for _ in range(200)]
+    seq_o = [other.next() for _ in range(200)]
+    assert seq_a == seq_b
+    assert seq_a != seq_o
+    kinds = {ev.kind for ev in seq_a}
+    assert kinds == {"ok", "drop", "delay", "crash"}  # all faults live
+    for ev in seq_a:
+        if ev.kind == "delay":
+            assert SMOKE_CHAOS.delay_min_s <= ev.arg <= SMOKE_CHAOS.delay_max_s
+
+
+def test_stall_schedule_deterministic_and_bounded():
+    policy = ChaosPolicy(SMOKE_CHAOS)
+    sched = policy.stall_schedule(3.0)
+    assert sched == policy.stall_schedule(3.0)
+    assert sched, "stalls enabled but schedule empty"
+    assert all(0 < t < 3.0 and d == SMOKE_CHAOS.receiver_stall_s
+               for t, d in sched)
+    assert ChaosPolicy(ChaosConfig()).stall_schedule(10.0) == []
+
+
+def test_chaos_config_validation():
+    with pytest.raises(ValueError):
+        ChaosConfig(drop_prob=1.5)
+    with pytest.raises(ValueError):
+        ChaosConfig(delay_min_s=0.2, delay_max_s=0.1)
+    assert not ChaosConfig().enabled()
+    assert SMOKE_CHAOS.enabled()
+
+
+def test_stall_gate_bounded_wait():
+    gate = StallGate()
+    assert gate.wait(timeout=0.1)  # open by default
+    gate.stall()
+    t0 = time.monotonic()
+    assert not gate.wait(timeout=0.05)  # bounded, not a deadlock
+    assert time.monotonic() - t0 < 1.0
+    gate.resume()
+    assert gate.wait(timeout=0.1)
+    assert gate.stalls == 1
+
+
+def test_fleet_smoke_n8_with_chaos():
+    """The tier-1 acceptance smoke: 8 lanes, every fault kind enabled,
+    seeded, seconds of wall clock — the plane must ingest rows, count
+    every loss, recover from crashes, and finish without a deadlock."""
+    result = FleetHarness(_smoke_config()).run()
+    assert result["deadlocks"] == 0
+    assert result["rows_per_sec"] > 0
+    assert result["rows_inserted"] > 0
+    assert result["ticks"] == 8 * 12
+    # accounting closes: every attempted row was inserted or counted lost
+    # (TCP frames accepted into a dying receiver's buffer are the only
+    # non-counted loss mode, and the receiver here outlives the lanes)
+    drops = result["drops"]
+    assert result["rows_inserted"] + drops["backpressure_rows"] \
+        + drops["shed_rows"] <= result["rows_attempted"]
+    # the seeded script fired every fault kind at this size (seed-pinned)
+    assert result["crashes"] > 0
+    assert drops["chaos_rows"] > 0
+    assert result["recovery"]["n"] > 0  # crash -> delivery measured
+    assert result["receiver_stalls"] > 0
+    lat = result["send_latency_ms"]
+    assert lat["n"] > 0 and lat["p99"] >= lat["p50"] > 0
+
+
+def test_fleet_seeded_run_reproducible_bitwise():
+    """Acceptance bar: seeded chaos runs reproduce bit-for-bit at the
+    harness level — the full fault script (actor, tick, kind, float arg)
+    is identical across two runs, as are the script-derived counters."""
+    a = FleetHarness(_smoke_config()).run()
+    b = FleetHarness(_smoke_config()).run()
+    assert a["chaos_log"] == b["chaos_log"]
+    assert a["crashes"] == b["crashes"]
+    assert a["drops"]["chaos_rows"] == b["drops"]["chaos_rows"]
+    assert a["ticks"] == b["ticks"]
+    # ...and a different seed yields a different script
+    c = FleetHarness(_smoke_config(
+        chaos=dataclasses.replace(SMOKE_CHAOS, seed=8))).run()
+    assert c["chaos_log"] != a["chaos_log"]
+
+
+def test_fleet_eviction_and_readmission_under_crash():
+    """A crashed lane whose outage exceeds the heartbeat timeout is
+    evicted; its post-restart stream re-admits it (service-side recovery
+    interval recorded)."""
+    chaos = ChaosConfig(crash_prob=0.2, restart_delay_s=0.4, seed=3)
+    result = FleetHarness(_smoke_config(
+        chaos=chaos, max_ticks=20, heartbeat_timeout=0.25,
+        evict_every_s=0.05)).run()
+    assert result["crashes"] > 0
+    assert result["evictions"] > 0
+    assert result["readmissions"] > 0
+    assert result["service_recovery"]["n"] > 0
+    assert result["service_recovery"]["mean_s"] > 0
+    assert result["deadlocks"] == 0
+
+
+def test_synthetic_block_shapes_and_determinism():
+    a = synthetic_block(16, 24, 4, seed=5)
+    b = synthetic_block(16, 24, 4, seed=5)
+    assert a.obs.shape == (16, 24) and a.action.shape == (16, 4)
+    np.testing.assert_array_equal(a.obs, b.obs)
+    assert a.obs.dtype == np.float32
+
+
+def test_fleet_process_mode_small():
+    """The optional subprocess mode: same lane loop, real processes. Kept
+    tiny (2 lanes, no chaos) — it pays a spawn+import per lane."""
+    cfg = _smoke_config(n_actors=2, max_ticks=4, mode="process",
+                        chaos=ChaosConfig(seed=1),
+                        connect_stagger_s=0.05)
+    result = FleetHarness(cfg).run()
+    assert result["mode"] == "process"
+    assert result["deadlocks"] == 0
+    assert result["rows_inserted"] == 2 * 4 * 16  # no chaos: all delivered
+    assert result["chaos_log"] and all(
+        ev[2] == "ok" for ev in result["chaos_log"])
+
+
+def test_fleet_config_validation():
+    with pytest.raises(ValueError):
+        FleetConfig(mode="coroutine")
+    assert FleetConfig(n_actors=4).demand_rows_per_sec() == 4 * 20.0
+
+
+@pytest.mark.slow
+def test_fleet_sweep_slow():
+    """A bounded two-point sweep through the real sweep runner (the full
+    {8..256} x 10 s version is ``python bench.py --fleet``; its artifact
+    is committed under docs/evidence/fleet/)."""
+    artifact = run_sweep(ns=(8, 32), duration_s=2.0,
+                         chaos=SMOKE_CHAOS, obs_dim=24, act_dim=4,
+                         capacity=50_000, rows_per_sec=100.0,
+                         block_rows=16, heartbeat_timeout=0.5,
+                         evict_every_s=0.1, send_timeout=0.5)
+    assert [row["n_actors"] for row in artifact["sweep"]] == [8, 32]
+    for row in artifact["sweep"]:
+        assert row["deadlocks"] == 0
+        assert row["rows_per_sec"] > 0
+        assert "chaos_log" not in row  # stripped: regenerable from seed
+        assert set(row["drops"]) == {"chaos_rows", "backpressure_rows",
+                                     "shed_batches", "shed_rows"}
+    assert artifact["metric"] == "fleet_rows_per_sec"
+    assert artifact["config"]["chaos"]["seed"] == SMOKE_CHAOS.seed
+
+
+def test_bench_fleet_entrypoint_importable():
+    """bench.bench_fleet is the integration point the artifact pipeline
+    calls; it must resolve without an accelerator backend."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    assert callable(bench.bench_fleet)
+
+
+def test_fleet_analysis_table_and_plot(tmp_path):
+    """actor_scaling renders the sweep artifact as table + PNG."""
+    from d4pg_tpu.analysis.actor_scaling import fleet_table, plot_fleet
+
+    artifact = run_sweep(ns=(4,), duration_s=0.0, chaos=SMOKE_CHAOS,
+                         max_ticks=4, obs_dim=24, act_dim=4,
+                         capacity=10_000, rows_per_sec=200.0,
+                         block_rows=8, heartbeat_timeout=0.5,
+                         evict_every_s=0.1, send_timeout=0.5)
+    table = fleet_table(artifact)
+    assert "rows/s" in table and "4" in table
+    out = plot_fleet(artifact, str(tmp_path / "fleet.png"))
+    import os
+
+    assert os.path.getsize(out) > 0
+
+
+def test_stop_event_interrupts_lanes():
+    """An externally-set stop event ends a duration-mode run early —
+    lanes are interruptible mid-sleep (no join timeouts burned)."""
+    cfg = _smoke_config(max_ticks=None, duration_s=0.5,
+                        chaos=ChaosConfig(seed=0), rows_per_sec=20.0)
+    t0 = time.monotonic()
+    result = FleetHarness(cfg).run()
+    assert time.monotonic() - t0 < 15.0
+    assert result["deadlocks"] == 0
+    assert threading.active_count() < 100  # lanes actually exited

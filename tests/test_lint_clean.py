@@ -42,6 +42,53 @@ def test_bench_and_entrypoints_lint_clean():
 
 
 @pytest.mark.lint
+def test_suppression_audit():
+    """Audit every ``# jaxlint: disable`` in the package + bench.py: each
+    must name only REGISTERED rules (a typo'd rule id suppresses nothing
+    and rots silently) and carry a justification comment on the flagged
+    line's neighborhood (the documented suppression contract — see
+    docs/architecture.md "Suppressions"). New packages (e.g. fleet/) ride
+    the same audit automatically."""
+    import re
+
+    from d4pg_tpu.lint.rules import RULES
+
+    directive = re.compile(r"#\s*jaxlint:\s*disable(?:-file)?=([\w,\- ]+)")
+    audited = 0
+    problems = []
+    files = [os.path.join(REPO_ROOT, "bench.py")]
+    for dirpath, _dirs, names in os.walk(PACKAGE_DIR):
+        files.extend(os.path.join(dirpath, n) for n in names
+                     if n.endswith(".py"))
+    for path in files:
+        with open(path) as f:
+            lines = f.readlines()
+        for i, line in enumerate(lines):
+            m = directive.search(line)
+            # the lint package's own docs/fixtures mention the directive
+            # in strings — only audit real trailing-comment suppressions
+            if m is None or os.sep + "lint" + os.sep in path:
+                continue
+            audited += 1
+            where = f"{os.path.relpath(path, REPO_ROOT)}:{i + 1}"
+            for rule in m.group(1).replace(" ", "").split(","):
+                if rule not in RULES:
+                    problems.append(f"{where}: unknown rule {rule!r}")
+            lo, hi = max(0, i - 3), min(len(lines), i + 2)
+            neighborhood = "".join(lines[lo:hi])
+            # justification = at least one comment line near the
+            # suppression that is NOT itself a directive
+            has_comment = any(
+                "#" in nl and not directive.search(nl)
+                for nl in lines[lo:hi]) or '"""' in neighborhood
+            if not has_comment:
+                problems.append(f"{where}: suppression without an adjacent "
+                                "justification comment")
+    assert audited > 0, "audit found no suppressions — regex rot?"
+    assert not problems, "\n".join(problems)
+
+
+@pytest.mark.lint
 def test_cli_module_entrypoint():
     """`python -m d4pg_tpu.lint <package>` is the documented interface; it
     must agree with the library API and exit 0 on the repo."""
