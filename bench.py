@@ -298,20 +298,33 @@ def bench_ingest(capacity: int = 200_000, block_rows: int = 4096,
 
 
 def bench_fleet(ns=(8, 32, 64, 128, 256), duration_s: float = 10.0,
-                seed: int = 0, chaos: bool = True) -> dict:
+                seed: int = 0, chaos: bool = True,
+                shard_ks=(1, 2, 4), shard_rows_per_sec: float = 60.0) -> dict:
     """Fleet fan-out sweep (``d4pg_tpu/fleet``): rows/s into ONE replay
     service from N throttled chaos-wrapped sender lanes over real TCP,
     N up to the BASELINE-mandated 256, with p50/p99 send latency, counted
     drops (chaos / backpressure / receiver sheds), retry and eviction/
     re-admission counts, and crash→recovery times. Pure host+TCP plane —
-    no accelerator involved — so it runs identically everywhere. Invoked
-    standalone as ``python bench.py --fleet`` (persists the artifact under
-    docs/evidence/fleet/)."""
+    no accelerator involved — so it runs identically everywhere.
+
+    The artifact carries TWO sweeps: the N sweep at K=1 (continuity with
+    PR 3's numbers) and the ``ingest_shards`` sweep K ∈ ``shard_ks`` at
+    N=max(ns) with offered load raised to ``shard_rows_per_sec`` per lane
+    so the RECEIVER saturates — rows/s-per-shard, scaling efficiency and
+    the margin over the old ~5,200 rows/s single-core ceiling are
+    recorded per K. Invoked standalone as ``python bench.py --fleet``
+    (persists the artifact under docs/evidence/fleet/)."""
     from d4pg_tpu.fleet.chaos import ChaosConfig
-    from d4pg_tpu.fleet.sweep import default_chaos, run_sweep
+    from d4pg_tpu.fleet.sweep import default_chaos, run_sweep, shard_sweep
 
     cc = default_chaos(seed) if chaos else ChaosConfig(seed=seed)
-    return run_sweep(ns=ns, duration_s=duration_s, chaos=cc)
+    artifact = run_sweep(ns=ns, duration_s=duration_s, chaos=cc)
+    artifact["shard_sweep"] = shard_sweep(
+        ks=shard_ks, n_actors=max(ns), duration_s=duration_s,
+        rows_per_sec=shard_rows_per_sec, chaos=cc)
+    for row in artifact["shard_sweep"]["sweep"]:
+        row.pop("chaos_log", None)
+    return artifact
 
 
 def bench_projection_variants(k: int = 40, steps: int = 1600) -> dict | None:

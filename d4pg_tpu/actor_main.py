@@ -54,6 +54,7 @@ def run_actor(
     send_timeout: float = 300.0,
     send_retries: int | None = None,
     drop_on_timeout: bool = False,
+    codec: str = "npz",
 ) -> int:
     cfg = cfg.resolve()
     obs_dim, act_dim, obs_dtype = infer_dims(cfg)
@@ -66,11 +67,15 @@ def run_actor(
     # frame may retry and what happens at the bound: raise (default, a
     # lone actor should fail loudly) or shed-and-count (a 256-actor fleet
     # member should lose rows, not wedge).
+    # --codec raw: the sharded receiver's native v2 frames — ~25x cheaper
+    # to encode+decode than npz and admissible (routed/shed/counted) from
+    # the fixed header alone; npz (default) interops with any receiver.
     sender = CoalescingSender(learner_host, transitions_port,
                               actor_id=actor_id, secret=secret,
                               retry_timeout=send_timeout,
                               max_retries=send_retries,
-                              drop_on_timeout=drop_on_timeout)
+                              drop_on_timeout=drop_on_timeout,
+                              codec=codec)
     weights = WeightClient(learner_host, weights_port, secret=secret)
     actor_cfg = ActorConfig(
         epsilon_0=cfg.epsilon_0, min_epsilon=cfg.min_epsilon,
@@ -191,6 +196,10 @@ def main(argv=None):
                    help="1: shed timed-out frames (counted) and keep "
                         "acting — the fleet-member policy; 0: raise and "
                         "stop (default)")
+    p.add_argument("--codec", choices=("npz", "raw"), default="npz",
+                   help="wire frame format: npz (legacy, self-describing) "
+                        "or raw (v2 column frames — the sharded receiver's "
+                        "native format, ~25x cheaper per frame)")
     ns = p.parse_args(argv)
     if ns.actor_device == "cpu":
         # Acting runs on host CPU; force the platform BEFORE any jax call
@@ -209,7 +218,8 @@ def main(argv=None):
                       secret=ns.secret or None,
                       send_timeout=ns.send_timeout,
                       send_retries=ns.send_retries,
-                      drop_on_timeout=bool(ns.drop_on_timeout))
+                      drop_on_timeout=bool(ns.drop_on_timeout),
+                      codec=ns.codec)
     print(f"collected {steps} env steps")
 
 

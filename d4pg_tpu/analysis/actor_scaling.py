@@ -194,6 +194,36 @@ def fleet_table(artifact: dict) -> str:
             f"{row['crashes']:>6} {row['readmissions']:>8} "
             + (f"{rec['mean_s']:>8.2f}" if rec["mean_s"] is not None
                else f"{'—':>8}"))
+    shard = artifact.get("shard_sweep")
+    if shard:
+        lines.append("")
+        lines.append(shard_table(shard))
+    return "\n".join(lines)
+
+
+def shard_table(shard: dict) -> str:
+    """Format the ``shard_sweep`` block: rows/s vs ingest shards K at
+    fixed N, with per-shard rate, speedup/efficiency vs K=1, and the
+    margin over the priced single-core ceiling."""
+    ceiling = shard.get("single_core_ceiling_rows_per_sec", 5200.0)
+    header = (f"ingest shards @ N={shard['n_actors']} "
+              f"(offered {shard['offered_rows_per_sec']:,.0f} rows/s, "
+              f"ceiling {ceiling:,.0f}/core)\n"
+              f"{'K':>3} {'codec':>6} {'rows/s':>8} {'per-shard':>10} "
+              f"{'vs K=1':>7} {'eff':>6} {'vs ceil':>8} {'p99ms':>8} "
+              f"{'deadlk':>7}")
+    lines = [header]
+    for row, sc in zip(shard["sweep"], shard["scaling"]):
+        lat = row["send_latency_ms"]
+        lines.append(
+            f"{row['ingest_shards']:>3} {row['codec']:>6} "
+            f"{row['rows_per_sec']:>8,.0f} "
+            f"{sc['rows_per_sec_per_shard']:>10,.0f} "
+            f"{sc['speedup_vs_k1'] if sc['speedup_vs_k1'] is not None else float('nan'):>6.2f}x "
+            f"{sc['efficiency'] if sc['efficiency'] is not None else float('nan'):>6.2f} "
+            f"{sc['vs_ceiling']:>7.2f}x "
+            f"{lat['p99'] if lat['p99'] is not None else float('nan'):>8.2f} "
+            f"{row['deadlocks']:>7}")
     return "\n".join(lines)
 
 

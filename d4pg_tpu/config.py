@@ -162,6 +162,10 @@ class ExperimentConfig:
     serve_secret: str = ""  # shared secret gating remote peers ('' = open)
     serve_transitions_port: int = 0  # 0 = ephemeral
     serve_weights_port: int = 0
+    # Receiver-side ingest shards (docs/architecture.md "Sharded
+    # receiver"): K SO_REUSEPORT listeners + K decode/stage workers + one
+    # ordered merge-commit thread. 1 = the legacy single-drain plane.
+    ingest_shards: int = 1
     profile_dir: str = ""  # capture an XLA trace of the first cycle
     # io
     log_dir: str = "runs"  # --log_dir
@@ -384,6 +388,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--serve_transitions_port", type=int,
                    default=d.serve_transitions_port)
     p.add_argument("--serve_weights_port", type=int, default=d.serve_weights_port)
+    p.add_argument("--ingest_shards", type=int, default=d.ingest_shards,
+                   help="receiver-side ingest shards: K SO_REUSEPORT "
+                        "listeners + K decode/stage workers + one ordered "
+                        "merge-commit thread (1 = legacy single drain)")
     p.add_argument("--profile_dir", default=d.profile_dir)
     p.add_argument("--log_dir", default=d.log_dir)
     p.add_argument("--seed", type=int, default=d.seed)

@@ -2,23 +2,99 @@
 
 Replaces the reference's per-process private replay buffers (each hogwild
 worker kept its own, ``ddpg.py:78-89``) with ONE central service the actors
-stream into — the D4PG-paper architecture. Ingest is a bounded queue drained
-by a background thread, so actor `add` calls never block the learner's
+stream into — the D4PG-paper architecture. Ingest is bounded queues drained
+by background workers, so actor `add` calls never block the learner's
 sample path; heartbeats give the failure detection the reference lacks
 (SURVEY.md §5: "a dead worker just ends").
+
+Sharded ingest plane (``num_ingest_shards=K``; docs/architecture.md
+"Sharded receiver"): admission, decode and staging are partitioned across
+K shards so the receiver host can spend K cores on the frame path instead
+of one. Ownership model:
+
+  - an **ingest shard** owns: its bounded admission deque, its shed
+    watermark and shed/decode counters, and one worker thread. Everything
+    a shard owns is guarded by that shard's single condition variable —
+    counter and queue mutate under the SAME lock, so a shard snapshot is
+    always consistent. Frame decode (``transport.decode_frame``) and the
+    fused path's column-major staging run on the shard worker.
+  - the **commit thread** (the single writer of replay state) merges the
+    shard outputs back into ONE coherent buffer: every admitted batch
+    carries a global admission ticket ``seq``; the commit thread inserts
+    strictly in ``seq`` order (shed or undecodable tickets are tombstoned
+    so the merge never stalls on them), folds the observation normalizer
+    in that same order (single-writer invariant preserved), and takes the
+    buffer lock once per merged group. At K=1 this degenerates to exactly
+    the old single-drain behavior: one queue, arrival order, same
+    counters.
+  - the **learner thread** stays the single owner of device handles
+    (``stage_block``/``commit_staged``), exactly as before.
+
+Lock order (enforced by the ``lock-order`` jaxlint rule): a shard
+condition is a LEAF lock — neither the buffer lock nor the service lock
+may be acquired while holding one. The commit thread acquires
+``_buffer_lock`` and ``_lock`` sequentially, never nested inside a shard
+condition.
 """
 
 from __future__ import annotations
 
-import queue
+import itertools
 import threading
 import time
+from collections import deque
 from typing import Optional
 
 import numpy as np
 
+from d4pg_tpu.distributed.transport import decode_frame, raw_frame_meta
 from d4pg_tpu.replay.prioritized import PrioritizedReplayBuffer
 from d4pg_tpu.replay.uniform import ReplayBuffer, TransitionBatch
+
+# Seconds the ordered merge may make zero progress while shard output is
+# waiting before it skips ahead to the smallest ready ticket (counted in
+# ``order_breaks``). A lost ticket is a bug, but the fleet-plane rule is
+# degrade-and-count, never wedge.
+_ORDER_GRACE_S = 5.0
+
+
+class _IngestShard:
+    """One ingest shard: admission deque + counters, all owned by ``cond``.
+
+    The worker thread and producers synchronize ONLY through ``cond``:
+    producers wait on it for space (blocking mode) and the worker notifies
+    after popping; counters mutate under the same lock as the queue they
+    describe, so ``snapshot()`` is consistent by construction."""
+
+    __slots__ = ("idx", "capacity", "shed_at", "cond", "q", "sheds",
+                 "shed_rows", "decode_errors", "rows_in", "staged_rows")
+
+    def __init__(self, idx: int, capacity: int, shed_at: int | None):
+        self.idx = idx
+        self.capacity = capacity
+        self.shed_at = shed_at
+        self.cond = threading.Condition()
+        # items: (seq, data, codec, actor_id, rows, count); codec None
+        # means ``data`` is an already-decoded TransitionBatch, else it is
+        # the undecoded wire payload for ``decode_frame(data, codec)``
+        self.q: deque = deque()
+        self.sheds = 0
+        self.shed_rows = 0
+        self.decode_errors = 0
+        self.rows_in = 0
+        self.staged_rows = 0
+
+    def snapshot(self) -> dict:
+        with self.cond:
+            return {
+                "shard": self.idx,
+                "queue_depth": len(self.q),
+                "sheds": self.sheds,
+                "shed_rows": self.shed_rows,
+                "decode_errors": self.decode_errors,
+                "rows_in": self.rows_in,
+                "staged_rows": self.staged_rows,
+            }
 
 
 class ReplayService:
@@ -29,104 +105,212 @@ class ReplayService:
         heartbeat_timeout: float = 30.0,
         obs_norm=None,
         shed_watermark: float | None = None,
+        num_ingest_shards: int = 1,
     ):
         """``shed_watermark`` (fraction of ``ingest_capacity``, fleet-plane
-        degradation): when the ingest queue stands at or above the
+        degradation): when an ingest shard's deque stands at or above the
         watermark, ``add`` sheds the OLDEST queued batch to admit the
         newest instead of blocking the caller — a stalled drain degrades
         the replay distribution (newest-biased, counted in ``sheds``/
         ``shed_rows``) rather than wedging 256 receiver threads. None
         (default) keeps the block-or-False contract of the training
-        loop."""
+        loop. ``ingest_capacity`` and the watermark are PER SHARD, so
+        K=1 semantics are bit-compatible with the old single queue."""
         self.buffer = buffer
-        # Optional RunningMeanStd (envs/normalizer.py). The drain thread is
-        # the SINGLE writer: it folds every ingested row (local, spawned or
-        # remote actors alike — they all stream RAW observations) into the
-        # statistics and inserts the rows normalized, so the learner only
-        # ever samples standardized data. Actors receive read-only
-        # statistics for their policy input via the weight channel.
+        # Optional RunningMeanStd (envs/normalizer.py). The COMMIT thread
+        # is the SINGLE writer: it folds every ingested row (local,
+        # spawned or remote actors alike — they all stream RAW
+        # observations) into the statistics in admission-ticket order and
+        # inserts the rows normalized, so the learner only ever samples
+        # standardized data. Actors receive read-only statistics for
+        # their policy input via the weight channel.
         self.obs_norm = obs_norm
-        self._queue: queue.Queue = queue.Queue(maxsize=ingest_capacity)
+        self.num_ingest_shards = max(1, int(num_ingest_shards))
         self._env_steps = 0
         self._lock = threading.Lock()
-        # Guards ALL buffer mutation/reads: the drain thread's add() races
-        # the learner thread's sample()/update_priorities() otherwise
-        # (segment-tree aggregates are multi-word updates).
+        # Guards ALL buffer mutation/reads: the commit thread's insert
+        # races the learner thread's sample()/update_priorities()
+        # otherwise (segment-tree aggregates are multi-word updates).
         self._buffer_lock = threading.Lock()
-        # Batches accepted into the queue but not yet inserted; counted on
-        # the producer side so flush() can't slip through the window between
-        # queue-pop and buffer insert.
+        # Batches accepted into a shard but not yet committed; counted on
+        # the producer side so flush() can't slip through the window
+        # between queue-pop and buffer insert.
         self._pending = 0
         self._heartbeats: dict[str, float] = {}
+        self._owner: dict[str, int] = {}  # actor -> owning ingest shard
         self._heartbeat_timeout = heartbeat_timeout
         # Fleet-plane degradation + recovery state (all under self._lock):
         # evicted actors are remembered so a resumed heartbeat RE-ADMITS
         # them (and records the outage length) instead of counting them
         # dead forever; shed counters surface every dropped batch.
-        self._shed_at = (
+        shed_at = (
             None if shed_watermark is None
             else max(1, min(ingest_capacity,
                             int(shed_watermark * ingest_capacity))))
-        self._evicted: dict[str, float] = {}
-        self._recovery_s: list[float] = []
-        self.sheds = 0
-        self.shed_rows = 0
+        self._shed_at = shed_at
         self.evictions = 0
         self.readmissions = 0
+        self._evicted: dict[str, float] = {}
+        self._recovery_s: list[float] = []
+        self._shards = [
+            _IngestShard(i, int(ingest_capacity), shed_at)
+            for i in range(self.num_ingest_shards)
+        ]
+        # The fused direct-stage fast path: shard workers copy rows
+        # straight into the buffer's per-shard staging ring (thread-safe
+        # by ring ownership — see replay/staging.MultiRingStaging) and
+        # the commit thread only does the ordered accounting. Requires a
+        # shard-aware buffer and no normalizer (the fold must stay
+        # ticket-ordered on the single writer).
+        self._direct_stage = (
+            self.num_ingest_shards > 1 and obs_norm is None
+            and getattr(buffer, "ingest_shards", 1) > 1
+            and hasattr(buffer, "add_sharded"))
+        # Ordered merge state, all under _commit_cond: per-shard output
+        # deques (seq-ascending by construction), tombstoned tickets, and
+        # the next ticket to commit.
+        self._commit_cond = threading.Condition()
+        self._out: list[deque] = [deque() for _ in self._shards]
+        self._skip: set[int] = set()
+        self._next_seq = 0
+        self._seq = itertools.count()
+        self.order_breaks = 0
         self._stop = threading.Event()
-        self._drain_thread = threading.Thread(target=self._drain, daemon=True)
-        self._drain_thread.start()
+        self._workers = [
+            threading.Thread(target=self._worker, args=(s,), daemon=True,
+                             name=f"ingest-shard-{s.idx}")
+            for s in self._shards
+        ]
+        self._commit_thread = threading.Thread(
+            target=self._commit_loop, daemon=True, name="ingest-commit")
+        # compat alias: the fleet harness's deadlock verdict checks the
+        # drain/commit thread's liveness under this name
+        self._drain_thread = self._commit_thread
+        for t in self._workers:
+            t.start()
+        self._commit_thread.start()
 
     # -- actor-facing ------------------------------------------------------
     def add(self, batch: TransitionBatch, actor_id: str = "local",
             block: bool = True, timeout: float | None = 5.0,
-            count_env_steps: bool = True) -> bool:
-        """Enqueue transitions (backpressure via the bounded queue). Returns
-        False if the queue stayed full past ``timeout``.
+            count_env_steps: bool = True, shard: int | None = None) -> bool:
+        """Enqueue transitions (backpressure via the bounded shard deque).
+        Returns False if the deque stayed full past ``timeout``.
 
         ``count_env_steps=False`` for rows that do not correspond to fresh
         environment interaction (HER relabels) — otherwise the env_steps
         counter inflates by (1 + her_ratio)x in HER runs.
 
         With a ``shed_watermark`` configured, ``add`` NEVER blocks: a
-        queue at the watermark sheds its oldest batch (counted) to admit
-        this one, and the call returns True."""
-        self.heartbeat(actor_id)
-        if batch.obs.shape[0] == 0:
+        shard at the watermark sheds its oldest batch (counted) to admit
+        this one, and the call returns True.
+
+        ``shard`` pins the ingest shard (the sharded receiver passes the
+        connection's shard); by default actors hash onto a stable one."""
+        n = int(batch.obs.shape[0])
+        s = self._route(actor_id, shard)
+        self.heartbeat(actor_id, shard=s.idx)
+        if n == 0:
             return True
+        return self._admit(s, batch, None, actor_id, n, count_env_steps,
+                           block, timeout)
+
+    def add_payload(self, payload: bytes, shard: int = 0,
+                    codec: str = "npz") -> bool:
+        """Admit one UNDECODED wire frame from the sharded receiver
+        (``transport.TransitionReceiver(on_payload=...)``). Raw (v2)
+        frames are admitted on header metadata alone — actor id and row
+        count come from ``raw_frame_meta`` — and decoded later on the
+        owning shard's worker; npz frames carry no cheap header, so they
+        are decoded here (the connection thread, exactly where the
+        unsharded receiver decodes them). Never blocks: the sharded plane
+        always runs with a shed watermark contract (a full shard sheds
+        oldest, counted)."""
+        if codec == "raw":
+            try:
+                actor_id, n, count = raw_frame_meta(payload)
+            except Exception:
+                s = self._shards[shard % self.num_ingest_shards]
+                with s.cond:
+                    s.decode_errors += 1
+                return False
+            data: object = payload
+        else:
+            try:
+                actor_id, batch, count = decode_frame(payload, codec)
+            except Exception:
+                s = self._shards[shard % self.num_ingest_shards]
+                with s.cond:
+                    s.decode_errors += 1
+                return False
+            n, codec, data = int(batch.obs.shape[0]), None, batch
+        s = self._shards[shard % self.num_ingest_shards]
+        self.heartbeat(actor_id, shard=s.idx)
+        if n == 0:
+            return True
+        return self._admit(s, data, codec, actor_id, n, count,
+                           block=False, timeout=None)
+
+    def _route(self, actor_id: str, shard: int | None) -> _IngestShard:
+        if shard is not None:
+            return self._shards[shard % self.num_ingest_shards]
+        if self.num_ingest_shards == 1:
+            return self._shards[0]
+        return self._shards[hash(actor_id) % self.num_ingest_shards]
+
+    def _admit(self, s: _IngestShard, data, codec, actor_id: str, rows: int,
+               count: bool, block: bool, timeout: float | None) -> bool:
         with self._lock:
             self._pending += 1
-        item = (actor_id, batch, count_env_steps)
-        if self._shed_at is not None:
-            return self._put_shedding(item)
-        try:
-            self._queue.put(item, block=block, timeout=timeout)
-            return True
-        except queue.Full:
+        shed_seqs: list[int] = []
+        shed_batches = 0
+        admitted = False
+        with s.cond:
+            if s.shed_at is not None:
+                # shed-oldest admission: bounded work, never blocks. The
+                # counter and the deque mutate under the same lock — the
+                # consistent-snapshot contract of ingest_stats().
+                while len(s.q) >= s.shed_at:
+                    old = s.q.popleft()
+                    s.sheds += 1
+                    s.shed_rows += old[4]
+                    shed_seqs.append(old[0])
+                    shed_batches += 1
+                admitted = True
+            elif len(s.q) >= s.capacity:
+                if block:
+                    deadline = (None if timeout is None
+                                else time.monotonic() + timeout)
+                    while (len(s.q) >= s.capacity
+                           and not self._stop.is_set()):
+                        remaining = (None if deadline is None
+                                     else deadline - time.monotonic())
+                        if remaining is not None and remaining <= 0:
+                            break
+                        s.cond.wait(0.1 if remaining is None
+                                    else min(remaining, 0.1))
+                admitted = len(s.q) < s.capacity
+            else:
+                admitted = True
+            if admitted:
+                seq = next(self._seq)
+                s.q.append((seq, data, codec, actor_id, rows, count))
+                s.rows_in += rows
+                s.cond.notify_all()
+        if shed_seqs:
+            self._tombstone(shed_seqs)
+        dropped = shed_batches + (0 if admitted else 1)
+        if dropped:
             with self._lock:
-                self._pending -= 1
-            return False
+                self._pending -= dropped  # sheds never reach the commit
+        return admitted
 
-    def _put_shedding(self, item) -> bool:
-        """Admit ``item``, shedding the oldest queued batch while the queue
-        stands at/above the watermark — bounded work, never blocks."""
-        while True:
-            if self._queue.qsize() < self._shed_at:
-                try:
-                    self._queue.put_nowait(item)
-                    return True
-                except queue.Full:
-                    pass  # racing producers filled it; fall through to shed
-            try:
-                _aid, old_batch, _cnt = self._queue.get_nowait()
-            except queue.Empty:
-                continue  # the drain thread beat us to it; retry the put
-            with self._lock:
-                self.sheds += 1
-                self.shed_rows += old_batch.obs.shape[0]
-                self._pending -= 1  # shed batches never reach the drain
+    def _tombstone(self, seqs: list[int]) -> None:
+        with self._commit_cond:
+            self._skip.update(seqs)
+            self._commit_cond.notify_all()
 
-    def heartbeat(self, actor_id: str) -> None:
+    def heartbeat(self, actor_id: str, shard: int | None = None) -> None:
         now = time.monotonic()
         with self._lock:
             evicted_at = self._evicted.pop(actor_id, None)
@@ -136,6 +320,8 @@ class ReplayService:
                 if len(self._recovery_s) < 10_000:
                     self._recovery_s.append(now - evicted_at)
             self._heartbeats[actor_id] = now
+            if shard is not None:
+                self._owner[actor_id] = shard
 
     # -- learner-facing ----------------------------------------------------
     def sample(self, batch_size: int, beta: float = 0.4,
@@ -143,7 +329,7 @@ class ReplayService:
         """PER: (batch, weights, idx, generation); uniform: batch. Mirrors
         the learner's buffer-kind dispatch (``ddpg.py:187-197``); the
         generation snapshot guards the priority write-back against the
-        drain thread overwriting a sampled slot in flight."""
+        commit thread overwriting a sampled slot in flight."""
         with self._buffer_lock:
             if isinstance(self.buffer, PrioritizedReplayBuffer):
                 batch, w, idx = self.buffer.sample(
@@ -157,7 +343,7 @@ class ReplayService:
         weights-or-None, idx [K, B], generation-or-None [K, B]) — the
         K-updates-per-dispatch sample path (``learner/pipeline.py``). The
         generation snapshot lets the deferred priority write-back skip
-        slots the drain thread overwrote in flight."""
+        slots the commit thread overwrote in flight."""
         with self._buffer_lock:
             if isinstance(self.buffer, PrioritizedReplayBuffer):
                 batches, w, idx = self.buffer.sample_chunk(
@@ -189,8 +375,8 @@ class ReplayService:
         """Flush ALL rows staged by a fused-path buffer
         (``replay/fused_buffer.py``) onto the device. Called by the
         LEARNER thread at cycle/chunk boundaries — it is the single owner
-        of the device handles, so the drain thread's ``add`` only stages
-        host rows and never dispatches device work."""
+        of the device handles, so the ingest workers only stage host rows
+        and never dispatch device work."""
         drain = getattr(self.buffer, "drain", None)
         if drain is None:
             return 0
@@ -293,74 +479,188 @@ class ReplayService:
             return list(self._evicted)
 
     def ingest_stats(self) -> dict:
-        """Degradation/recovery counters for the fleet plane: sheds,
-        evictions, re-admissions, recovery times, live queue depth."""
+        """Degradation/recovery counters for the fleet plane. Snapshot
+        consistency: every counter is read under the SAME lock that
+        writes it — per-shard counters atomically with the queue they
+        describe (one shard condition each), the env_steps/pending pair
+        and heartbeat state atomically under the service lock — so the
+        numbers can never show e.g. a shed whose queue pop is missing.
+        Cross-shard totals are sums of per-shard-consistent snapshots."""
+        per_shard = [s.snapshot() for s in self._shards]
+        with self._commit_cond:
+            commit_backlog = sum(len(dq) for dq in self._out)
+            order_breaks = self.order_breaks
         with self._lock:
-            return {
+            merged = {
                 "env_steps": self._env_steps,
                 "pending": self._pending,
-                "queue_depth": self._queue.qsize(),
-                "sheds": self.sheds,
-                "shed_rows": self.shed_rows,
                 "evictions": self.evictions,
                 "readmissions": self.readmissions,
                 "recovery_s": list(self._recovery_s),
                 "live_actors": len(self._heartbeats),
                 "evicted": len(self._evicted),
             }
+        merged.update({
+            "queue_depth": sum(p["queue_depth"] for p in per_shard),
+            "sheds": sum(p["sheds"] for p in per_shard),
+            "shed_rows": sum(p["shed_rows"] for p in per_shard),
+            "decode_errors": sum(p["decode_errors"] for p in per_shard),
+            "num_ingest_shards": self.num_ingest_shards,
+            "commit_backlog": commit_backlog,
+            "order_breaks": order_breaks,
+            "per_shard": per_shard,
+        })
+        return merged
 
     # -- internals ---------------------------------------------------------
-    # Max batches folded into one coalesced insert pass: bounds the lock
+    # Max batches folded into one merged commit pass: bounds the lock
     # hold (the learner's sample path waits on the same lock) while still
     # amortizing it ~64x under a streaming fleet.
     _COALESCE = 64
 
-    def _drain(self) -> None:
+    def _worker(self, s: _IngestShard) -> None:
+        """Shard worker: pop a coalesced group, decode wire payloads
+        (the CPU-heavy half of ingest), optionally direct-stage into the
+        buffer's shard ring, and hand the group to the ordered merge.
+
+        Backpressure discipline: at most ONE decoded group per shard sits
+        in the merge's inbox — the worker waits for the commit thread to
+        take its previous group before popping the next. Decode of group
+        t+1 thereby overlaps the insert of group t (the pipeline), while
+        a slow commit still backs pressure up into the shard deque where
+        the shed watermark / blocking-add contract lives, exactly like
+        the single drain thread it replaces."""
         while not self._stop.is_set():
-            try:
-                batches = [self._queue.get(timeout=0.1)]
-            except queue.Empty:
+            with self._commit_cond:
+                while self._out[s.idx] and not self._stop.is_set():
+                    self._commit_cond.wait(timeout=0.1)
+            with s.cond:
+                if not s.q:
+                    s.cond.wait(timeout=0.1)
+                items = []
+                while s.q and len(items) < self._COALESCE:
+                    items.append(s.q.popleft())
+                if items:
+                    s.cond.notify_all()  # space freed: wake blocked adds
+            if not items:
                 continue
-            # Coalesce: take everything already queued (up to _COALESCE)
-            # so a streaming fleet pays ONE lock acquisition and one
-            # normalizer fold per group instead of per actor send — the
-            # ingest plane's host-side amortization, matching the
-            # block-granular device drain downstream.
-            while len(batches) < self._COALESCE:
-                try:
-                    batches.append(self._queue.get_nowait())
-                except queue.Empty:
-                    break
-            try:
-                if self.obs_norm is not None:
-                    # Only obs rows feed the estimator; next_obs is
-                    # normalized but never folded in. The episode-FINAL
-                    # next_obs is thereby excluded — intentional: there is
-                    # no row-level marker for "truly final" here (done=1
-                    # tags every n-step fold of a terminal AND HER success
-                    # relabels mid-trajectory, so done-gating would weight
-                    # terminal-adjacent states 2-5x instead), and the
-                    # omission is one state in T per episode. Stats fold
-                    # BEFORE any of the group's rows are normalized, in
-                    # arrival order — same estimator as the per-batch loop.
-                    for j, (aid, batch, cnt) in enumerate(batches):
-                        self.obs_norm.update(batch.obs)
-                        batches[j] = (aid, batch._replace(
-                            obs=self.obs_norm.normalize(batch.obs),
-                            next_obs=self.obs_norm.normalize(batch.next_obs),
-                        ), cnt)
-                with self._buffer_lock:
-                    for _aid, batch, _cnt in batches:
-                        self.buffer.add(batch)
-            finally:
+            out, dead, staged = [], [], 0
+            for seq, data, codec, actor_id, rows, count in items:
+                if codec is not None:
+                    try:
+                        actor_id, batch, count = decode_frame(data, codec)
+                    except Exception:
+                        dead.append(seq)
+                        continue
+                    rows = int(batch.obs.shape[0])
+                else:
+                    batch = data
+                if self._direct_stage:
+                    # rows land in the buffer's per-shard staging ring
+                    # HERE, on the shard core; the commit thread only
+                    # settles the ordered accounting for this ticket
+                    self.buffer.add_sharded(batch, s.idx, ticket=seq)
+                    staged += rows
+                    batch = None
+                out.append((seq, actor_id, batch, rows, count))
+            if dead or staged:
+                with s.cond:
+                    s.decode_errors += len(dead)
+                    s.staged_rows += staged
+            with self._commit_cond:
+                self._out[s.idx].extend(out)
+                if dead:
+                    self._skip.update(dead)
+                self._commit_cond.notify_all()
+            if dead:
                 with self._lock:
-                    for _, batch, count in batches:
-                        if count:
-                            self._env_steps += batch.obs.shape[0]
-                    self._pending -= len(batches)
+                    self._pending -= len(dead)
+
+    def _pop_ready(self, group: list) -> None:
+        """Pop the next run of in-ticket-order items (caller holds
+        ``_commit_cond``). Tombstoned tickets are consumed and skipped."""
+        while len(group) < self._COALESCE:
+            while self._next_seq in self._skip:
+                self._skip.discard(self._next_seq)
+                self._next_seq += 1
+            found = None
+            for dq in self._out:
+                if dq and dq[0][0] == self._next_seq:
+                    found = dq.popleft()
+                    break
+            if found is None:
+                break
+            group.append(found)
+            self._next_seq += 1
+
+    def _commit_loop(self) -> None:
+        """The single writer of replay state: ordered K-way merge of the
+        shard outputs, normalizer fold, one buffer-lock acquisition per
+        merged group."""
+        last_progress = time.monotonic()
+        while True:
+            group: list = []
+            with self._commit_cond:
+                self._pop_ready(group)
+                if not group:
+                    if self._stop.is_set():
+                        return
+                    self._commit_cond.wait(timeout=0.1)
+                    self._pop_ready(group)
+                if group:
+                    # inbox slots freed: wake gated shard workers
+                    self._commit_cond.notify_all()
+                backlog = any(self._out[i] for i in range(len(self._out)))
+            if group:
+                last_progress = time.monotonic()
+                self._insert_group(group)
+            elif (backlog and time.monotonic() - last_progress
+                    > _ORDER_GRACE_S):
+                # safety valve: a ticket vanished without a tombstone.
+                # Skip to the smallest ready ticket (counted) rather than
+                # wedging the whole ingest plane behind it.
+                with self._commit_cond:
+                    heads = [dq[0][0] for dq in self._out if dq]
+                    if heads and min(heads) > self._next_seq:
+                        self.order_breaks += 1
+                        self._next_seq = min(heads)
+                last_progress = time.monotonic()
+
+    def _insert_group(self, group: list) -> None:
+        try:
+            if self.obs_norm is not None:
+                # Only obs rows feed the estimator; next_obs is
+                # normalized but never folded in. The episode-FINAL
+                # next_obs is thereby excluded — intentional: there is
+                # no row-level marker for "truly final" here (done=1
+                # tags every n-step fold of a terminal AND HER success
+                # relabels mid-trajectory, so done-gating would weight
+                # terminal-adjacent states 2-5x instead), and the
+                # omission is one state in T per episode. Stats fold
+                # BEFORE any of the group's rows are normalized, in
+                # admission-ticket order — same estimator as the
+                # per-batch loop, regardless of shard interleaving.
+                for j, (seq, aid, batch, rows, cnt) in enumerate(group):
+                    if batch is None:
+                        continue
+                    self.obs_norm.update(batch.obs)
+                    group[j] = (seq, aid, batch._replace(
+                        obs=self.obs_norm.normalize(batch.obs),
+                        next_obs=self.obs_norm.normalize(batch.next_obs),
+                    ), rows, cnt)
+            with self._buffer_lock:
+                for _seq, _aid, batch, _rows, _cnt in group:
+                    if batch is not None:  # None: already direct-staged
+                        self.buffer.add(batch)
+        finally:
+            with self._lock:
+                for _seq, _aid, _batch, rows, count in group:
+                    if count:
+                        self._env_steps += rows
+                self._pending -= len(group)
 
     def flush(self, timeout: float = 5.0) -> None:
-        """Block until every accepted batch has been inserted."""
+        """Block until every accepted batch has been committed."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._lock:
@@ -371,4 +671,11 @@ class ReplayService:
     def close(self) -> None:
         self.flush()
         self._stop.set()
-        self._drain_thread.join(timeout=2.0)
+        for s in self._shards:
+            with s.cond:
+                s.cond.notify_all()
+        with self._commit_cond:
+            self._commit_cond.notify_all()
+        for t in self._workers:
+            t.join(timeout=2.0)
+        self._commit_thread.join(timeout=2.0)

@@ -37,11 +37,14 @@ import numpy as np
 
 from d4pg_tpu.replay.uniform import TransitionBatch
 
-_MAGIC = 0xD4F6
+_MAGIC = 0xD4F6  # v1 frames: npz payload (self-describing, slow to parse)
+_MAGIC_RAW = 0xD4F8  # v2 frames: raw column payload (fixed header + blobs)
 _HEADER = struct.Struct("!II")
 _NONCE_LEN = 16
 _MAC_LEN = 32  # sha256 digest
 MAX_PAYLOAD = 64 << 20  # 64 MiB: far above any sane batch/param frame
+
+CODECS = ("npz", "raw")
 
 
 def _hs_mac(secret: str, nonce: bytes) -> bytes:
@@ -113,6 +116,94 @@ def _decode(payload: bytes) -> tuple[str, TransitionBatch, bool]:
         )
         count = bool(z["count"]) if "count" in z.files else True
     return actor_id, batch, count
+
+
+# -- v2 raw column codec ---------------------------------------------------
+#
+# The npz codec costs ~1 ms of host CPU per 16-row Humanoid frame (zipfile
+# member parsing on both ends) — measured as the dominant share of the
+# ~5,200 rows/s/core ingest ceiling the fleet sweep hit. The v2 frame is
+# the sharded ingest plane's native format: a fixed struct header carrying
+# actor id, row count and per-field (dtype, shape), then the raw
+# C-contiguous column bytes back to back. Decode is a header parse plus
+# six ``np.frombuffer`` views (~30 us/frame), and — the part sharding
+# needs — ``raw_frame_meta`` reads actor id / row count / count-flag from
+# the header WITHOUT touching the columns, so admission can route, shed
+# (with exact row accounting) and heartbeat before any decode happens.
+
+_RAW_PRE = struct.Struct("!BB")  # count_flag, len(actor_id)
+
+
+def encode_raw(actor_id: str, batch: TransitionBatch,
+               count_env_steps: bool = True) -> bytes:
+    aid = actor_id.encode()
+    if len(aid) > 255:
+        raise ValueError("actor_id longer than 255 bytes")
+    head = [_RAW_PRE.pack(int(count_env_steps), len(aid)), aid,
+            struct.pack("!B", len(batch))]
+    blobs = []
+    for v in batch:
+        a = np.ascontiguousarray(v)
+        ds = a.dtype.str.encode()
+        head.append(struct.pack("!BB", len(ds), a.ndim) + ds
+                    + struct.pack(f"!{a.ndim}I", *a.shape))
+        blobs.append(a.tobytes())
+    payload = b"".join(head) + b"".join(blobs)
+    return _HEADER.pack(_MAGIC_RAW, len(payload)) + payload
+
+
+def _raw_header(payload: bytes):
+    """Parse the v2 header: (actor_id, count, [(dtype, shape)], data_off)."""
+    count, laid = _RAW_PRE.unpack_from(payload, 0)
+    off = _RAW_PRE.size
+    actor_id = payload[off:off + laid].decode()
+    off += laid
+    (nf,) = struct.unpack_from("!B", payload, off)
+    off += 1
+    fields = []
+    for _ in range(nf):
+        lds, ndim = struct.unpack_from("!BB", payload, off)
+        off += 2
+        dtype = np.dtype(payload[off:off + lds].decode())
+        off += lds
+        shape = struct.unpack_from(f"!{ndim}I", payload, off)
+        off += 4 * ndim
+        fields.append((dtype, shape))
+    return actor_id, bool(count), fields, off
+
+
+def raw_frame_meta(payload: bytes) -> tuple[str, int, bool]:
+    """(actor_id, n_rows, count_env_steps) from the header alone — no
+    column bytes touched. The admission-time accounting hook for the
+    sharded receiver (shed rows are counted exactly without a decode)."""
+    actor_id, count, fields, _ = _raw_header(payload)
+    n = int(fields[0][1][0]) if fields and fields[0][1] else 0
+    return actor_id, n, count
+
+
+def decode_raw(payload: bytes) -> tuple[str, TransitionBatch, bool]:
+    actor_id, count, fields, off = _raw_header(payload)
+    if len(fields) != len(TransitionBatch._fields):
+        raise ProtocolError(
+            f"raw frame carries {len(fields)} fields, expected "
+            f"{len(TransitionBatch._fields)}")
+    cols = []
+    for dtype, shape in fields:
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        end = off + n * dtype.itemsize
+        if end > len(payload):
+            raise ProtocolError("raw frame truncated mid-column")
+        # zero-copy read-only views into the payload: every consumer
+        # copies rows onward (staging ring / storage write) anyway
+        cols.append(np.frombuffer(payload, dtype, n, off).reshape(shape))
+        off = end
+    return actor_id, TransitionBatch(*cols), count
+
+
+def decode_frame(payload: bytes, codec: str) -> tuple[str, TransitionBatch, bool]:
+    """Decode one payload by codec name ('npz' | 'raw') — the hook the
+    sharded ``ReplayService`` workers use for lazy decode."""
+    return decode_raw(payload) if codec == "raw" else _decode(payload)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
@@ -243,7 +334,11 @@ class TransitionSender(ReconnectingClient):
                  max_retries: Optional[int] = None,
                  drop_on_timeout: bool = False,
                  backoff_base: float = 0.2, backoff_max: float = 5.0,
-                 backoff_seed: Optional[int] = None):
+                 backoff_seed: Optional[int] = None,
+                 codec: str = "npz"):
+        if codec not in CODECS:
+            raise ValueError(f"unknown codec {codec!r}; one of {CODECS}")
+        self.codec = codec
         self.actor_id = actor_id
         self._retry_timeout = retry_timeout
         self._max_retries = max_retries
@@ -264,7 +359,8 @@ class TransitionSender(ReconnectingClient):
         or ``max_retries`` reconnect attempts — is exhausted first."""
         import time
 
-        data = _encode(self.actor_id, batch, count_env_steps)
+        data = (encode_raw if self.codec == "raw" else _encode)(
+            self.actor_id, batch, count_env_steps)
         with self._lock:
             self._check_open()
             budget = self._retry_timeout if timeout is None else timeout
@@ -349,13 +445,14 @@ class CoalescingSender(TransitionSender):
                  max_retries: Optional[int] = None,
                  drop_on_timeout: bool = False,
                  backoff_base: float = 0.2, backoff_max: float = 5.0,
-                 backoff_seed: Optional[int] = None):
+                 backoff_seed: Optional[int] = None,
+                 codec: str = "npz"):
         super().__init__(host, port, actor_id,
                          connect_timeout=connect_timeout, secret=secret,
                          retry_timeout=retry_timeout, max_retries=max_retries,
                          drop_on_timeout=drop_on_timeout,
                          backoff_base=backoff_base, backoff_max=backoff_max,
-                         backoff_seed=backoff_seed)
+                         backoff_seed=backoff_seed, codec=codec)
         self._min_block = max(1, int(min_block))
         self._max_block = max(self._min_block, int(max_block))
         self._target = self._min_block
@@ -475,7 +572,19 @@ class ConnRegistry:
 class TransitionReceiver(ConnRegistry):
     """Learner-side server: accepts actor connections, decodes frames, and
     forwards batches into a callback (normally ``ReplayService.add``).
-    The callback receives ``(batch, actor_id, count_env_steps)``."""
+    The callback receives ``(batch, actor_id, count_env_steps)``.
+
+    Sharded mode (``num_shards=K``, the multi-core ingest plane): K
+    listening sockets share the port via ``SO_REUSEPORT`` — the kernel
+    spreads incoming connections across them, so accept/read work has no
+    single hot socket — and every connection carries the shard index of
+    the listener that accepted it (round-robin assignment from a single
+    listener where ``SO_REUSEPORT`` is unavailable). With an
+    ``on_payload`` callback set, frames are forwarded UNDECODED as
+    ``(payload, shard, codec)`` so decode runs on the owning ingest
+    shard's worker core (``ReplayService.add_payload``) instead of the
+    connection thread; without it this class decodes both frame formats
+    itself and calls ``on_batch`` exactly as before."""
 
     def __init__(
         self,
@@ -484,39 +593,79 @@ class TransitionReceiver(ConnRegistry):
         port: int = 0,
         secret: Optional[str] = None,
         max_payload: int = MAX_PAYLOAD,
+        num_shards: int = 1,
+        on_payload: Optional[Callable[[bytes, int, str], object]] = None,
     ):
         super().__init__()
         self._on_batch = on_batch
+        self._on_payload = on_payload
         self._secret = secret
         self._max_payload = int(max_payload)
-        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._server.bind((host, port))
-        self._server.listen()
+        self.num_shards = max(1, int(num_shards))
+        self._servers: list[socket.socket] = []
+        self._rr = 0  # round-robin shard cursor (fallback path)
+        self.reuseport = False
+        bind_port = port
+        for _ in range(self.num_shards):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            if self.num_shards > 1:
+                try:
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+                except (AttributeError, OSError):
+                    # platform without SO_REUSEPORT: ONE listener,
+                    # connections assigned to shards round-robin
+                    if self._servers:
+                        s.close()
+                        break
+            try:
+                s.bind((host, bind_port))
+            except OSError:
+                s.close()
+                if self._servers:
+                    break  # fall back to the listeners we already have
+                raise
+            s.listen()
+            bind_port = s.getsockname()[1]
+            self._servers.append(s)
+            if self.num_shards == 1:
+                break
+        self.reuseport = len(self._servers) == self.num_shards > 1
+        self._server = self._servers[0]  # compat alias (close/tests)
         self.port = self._server.getsockname()[1]
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
-        self._accept_thread = threading.Thread(target=self._accept, daemon=True)
-        self._accept_thread.start()
+        self._accept_threads = [
+            threading.Thread(target=self._accept, args=(srv, i), daemon=True)
+            for i, srv in enumerate(self._servers)
+        ]
+        for t in self._accept_threads:
+            t.start()
 
-    def _accept(self) -> None:
+    def _accept(self, server: socket.socket, listener_idx: int) -> None:
         while not self._stop.is_set():
             try:
-                self._server.settimeout(0.2)
-                conn, _ = self._server.accept()
+                server.settimeout(0.2)
+                conn, _ = server.accept()
             except socket.timeout:
                 continue
             except OSError:
                 return
+            if self.reuseport:
+                shard = listener_idx
+            else:
+                shard = self._rr % self.num_shards
+                self._rr += 1
             # reap finished connection threads (a long-lived service with a
             # churning fleet otherwise grows this list without bound)
             self._threads = [t for t in self._threads if t.is_alive()]
             self._register_conn(conn)
-            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t = threading.Thread(target=self._serve, args=(conn, shard),
+                                 daemon=True)
             t.start()
             self._threads.append(t)
 
-    def _serve(self, conn: socket.socket) -> None:
+    def _serve(self, conn: socket.socket, shard: int = 0) -> None:
         try:
             with conn:
                 if not server_handshake(conn, self._secret):
@@ -526,24 +675,31 @@ class TransitionReceiver(ConnRegistry):
                     if header is None:
                         return
                     magic, length = _HEADER.unpack(header)
-                    if magic != _MAGIC or length > self._max_payload:
+                    if (magic not in (_MAGIC, _MAGIC_RAW)
+                            or length > self._max_payload):
                         return  # corrupt or hostile stream; drop the connection
                     payload = _recv_exact(conn, length)
                     if payload is None:
                         return
-                    actor_id, batch, count = _decode(payload)
+                    codec = "raw" if magic == _MAGIC_RAW else "npz"
+                    if self._on_payload is not None:
+                        # sharded plane: decode on the shard worker core
+                        self._on_payload(payload, shard, codec)
+                        continue
+                    actor_id, batch, count = decode_frame(payload, codec)
                     self._on_batch(batch, actor_id, count)
-        except OSError:
-            return  # peer died mid-frame (actor killed); just drop it
+        except (OSError, ProtocolError):
+            return  # peer died mid-frame / corrupt stream; just drop it
         finally:
             self._unregister_conn(conn)
 
     def close(self) -> None:
         self._stop.set()
-        try:
-            self._server.close()
-        except OSError:
-            pass
+        for s in self._servers:
+            try:
+                s.close()
+            except OSError:
+                pass
         self._shutdown_conns()
         for t in self._threads:
             t.join(timeout=1.0)

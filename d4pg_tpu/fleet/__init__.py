@@ -21,7 +21,12 @@ from d4pg_tpu.fleet.chaos import (
 )
 from d4pg_tpu.fleet.harness import FleetConfig, FleetHarness
 from d4pg_tpu.fleet.sender import ThrottledSender, synthetic_block
-from d4pg_tpu.fleet.sweep import SWEEP_NS, default_chaos, run_sweep
+from d4pg_tpu.fleet.sweep import (
+    SWEEP_NS,
+    default_chaos,
+    run_sweep,
+    shard_sweep,
+)
 
 __all__ = [
     "ActorChaos",
@@ -36,4 +41,5 @@ __all__ = [
     "SWEEP_NS",
     "default_chaos",
     "run_sweep",
+    "shard_sweep",
 ]

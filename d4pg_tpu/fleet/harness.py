@@ -54,14 +54,41 @@ class FleetConfig:
     evict_every_s: float = 0.5
     send_timeout: float = 1.0
     max_retries: int | None = 4
-    mode: str = "thread"  # 'thread' | 'process'
+    # 'thread' | 'process' | 'actor' — 'actor' lanes spawn REAL
+    # ``actor_main`` subprocesses (env + policy + n-step folding) against
+    # the harness's receiver + a live weight server, closing the
+    # "harness drives only the transport slice" gap; chaos injection does
+    # not apply there (real actors own their own fault story).
+    mode: str = "thread"
+    # Sharded ingest plane: K accept/decode/commit shards on the receiver
+    # (``ReplayService(num_ingest_shards=K)`` behind a
+    # ``TransitionReceiver(num_shards=K)``).
+    ingest_shards: int = 1
+    # 'auto' | 'npz' | 'raw'. auto resolves to the sharded plane's native
+    # v2 raw-column frames when ingest_shards > 1 (their fixed header is
+    # what zero-decode admission/routing needs) and to the legacy npz
+    # frames at K=1 — so a K=1 sweep row measures the plane exactly as
+    # PR 3 shipped it.
+    codec: str = "auto"
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
     template_seed: int = 0
     connect_stagger_s: float = 0.002  # per-lane offset on the connect storm
+    # 'actor' mode knobs: the env each real actor runs and its pool width
+    actor_env: str = "point"
+    actor_num_envs: int = 2
 
     def __post_init__(self):
-        if self.mode not in ("thread", "process"):
+        if self.mode not in ("thread", "process", "actor"):
             raise ValueError(f"unknown fleet mode {self.mode!r}")
+        if self.codec not in ("auto", "npz", "raw"):
+            raise ValueError(f"unknown codec {self.codec!r}")
+        if self.ingest_shards < 1:
+            raise ValueError("ingest_shards must be >= 1")
+
+    def resolved_codec(self) -> str:
+        if self.codec != "auto":
+            return self.codec
+        return "raw" if self.ingest_shards > 1 else "npz"
 
     def demand_rows_per_sec(self) -> float:
         return self.n_actors * self.rows_per_sec
@@ -114,25 +141,56 @@ class FleetHarness:
         self.config = config
         self.policy = ChaosPolicy(config.chaos)
 
+    # -- shared receiver construction --------------------------------------
+    def _make_service(self, obs_dim: int | None = None,
+                      act_dim: int | None = None) -> ReplayService:
+        cfg = self.config
+        return ReplayService(
+            ReplayBuffer(cfg.capacity,
+                         cfg.obs_dim if obs_dim is None else obs_dim,
+                         cfg.act_dim if act_dim is None else act_dim),
+            ingest_capacity=cfg.ingest_capacity,
+            heartbeat_timeout=cfg.heartbeat_timeout,
+            shed_watermark=cfg.shed_watermark,
+            num_ingest_shards=cfg.ingest_shards,
+        )
+
+    def _make_receiver(self, service: ReplayService,
+                       gate: StallGate | None = None) -> TransitionReceiver:
+        """K>1: shard-aware receiver forwarding UNDECODED payloads so
+        decode runs on the owning ingest shard's worker; K=1: the legacy
+        decode-in-connection-thread path, bit-compatible with PR 3."""
+        cfg = self.config
+        if cfg.ingest_shards > 1:
+            def on_payload(payload, shard, codec):
+                if gate is not None:
+                    gate.wait()
+                service.add_payload(payload, shard=shard, codec=codec)
+
+            return TransitionReceiver(
+                lambda b, aid, count: service.add(
+                    b, actor_id=aid, block=False, count_env_steps=count),
+                host="127.0.0.1", num_shards=cfg.ingest_shards,
+                on_payload=on_payload)
+
+        def on_batch(batch, actor_id, count):
+            if gate is not None:
+                gate.wait()
+            service.add(batch, actor_id=actor_id, block=False,
+                        count_env_steps=count)
+
+        return TransitionReceiver(on_batch, host="127.0.0.1")
+
     # -- thread mode -------------------------------------------------------
     def run(self) -> dict:
         cfg = self.config
         if cfg.mode == "process":
             return self._run_processes()
-        service = ReplayService(
-            ReplayBuffer(cfg.capacity, cfg.obs_dim, cfg.act_dim),
-            ingest_capacity=cfg.ingest_capacity,
-            heartbeat_timeout=cfg.heartbeat_timeout,
-            shed_watermark=cfg.shed_watermark,
-        )
+        if cfg.mode == "actor":
+            return self._run_actors()
+        service = self._make_service()
         gate = StallGate()
-
-        def on_batch(batch, actor_id, count):
-            gate.wait()
-            service.add(batch, actor_id=actor_id, block=False,
-                        count_env_steps=count)
-
-        receiver = TransitionReceiver(on_batch, host="127.0.0.1")
+        receiver = self._make_receiver(service, gate)
         template = synthetic_block(cfg.block_rows, cfg.obs_dim, cfg.act_dim,
                                    seed=cfg.template_seed)
         stop = threading.Event()
@@ -144,6 +202,7 @@ class FleetHarness:
                 send_timeout=cfg.send_timeout, max_retries=cfg.max_retries,
                 max_ticks=cfg.max_ticks, stop=stop,
                 connect_stagger_s=i * cfg.connect_stagger_s,
+                codec=cfg.resolved_codec(),
             )
             for i in range(cfg.n_actors)
         ]
@@ -219,16 +278,8 @@ class FleetHarness:
         from d4pg_tpu.fleet.sender import _process_lane_main
 
         cfg = self.config
-        service = ReplayService(
-            ReplayBuffer(cfg.capacity, cfg.obs_dim, cfg.act_dim),
-            ingest_capacity=cfg.ingest_capacity,
-            heartbeat_timeout=cfg.heartbeat_timeout,
-            shed_watermark=cfg.shed_watermark,
-        )
-        receiver = TransitionReceiver(
-            lambda b, aid, count: service.add(b, actor_id=aid, block=False,
-                                              count_env_steps=count),
-            host="127.0.0.1")
+        service = self._make_service()
+        receiver = self._make_receiver(service)
         ctx = mp.get_context("spawn")
         out_q = ctx.Queue()
         duration = (cfg.duration_s if cfg.max_ticks is None
@@ -246,6 +297,7 @@ class FleetHarness:
                 "send_timeout": cfg.send_timeout,
                 "max_retries": cfg.max_retries, "max_ticks": cfg.max_ticks,
                 "connect_stagger_s": i * cfg.connect_stagger_s,
+                "codec": cfg.resolved_codec(),
             }
             p = ctx.Process(target=_process_lane_main,
                             args=(kwargs, duration, out_q), daemon=True)
@@ -274,6 +326,93 @@ class FleetHarness:
                             dt=dt, service_stats=stats, deadlocks=deadlocks,
                             stalls=0)
 
+    # -- real-actor mode ---------------------------------------------------
+    def _run_actors(self) -> dict:
+        """Lanes are REAL ``actor_main`` subprocesses: env pool + policy
+        inference + n-step folding + ``CoalescingSender`` over real TCP,
+        pulling live weights from a ``WeightServer`` — the full actor
+        path, not the transport slice (ROADMAP: "fleet lanes driving REAL
+        actor processes"). Each lane runs ``max_ticks`` pool steps (so
+        offered rows are exact: ticks x num_envs), then the report closes
+        the same accounting as the synthetic lanes."""
+        import multiprocessing as mp
+
+        import jax
+
+        from d4pg_tpu.config import ExperimentConfig
+        from d4pg_tpu.distributed.weight_server import WeightServer
+        from d4pg_tpu.distributed.weights import WeightStore
+        from d4pg_tpu.fleet.sender import _actor_lane_main
+        from d4pg_tpu.learner import init_state
+        from d4pg_tpu.train import infer_dims
+
+        cfg = self.config
+        ticks = cfg.max_ticks if cfg.max_ticks is not None else 30
+        acfg = ExperimentConfig(
+            env=cfg.actor_env, num_envs=cfg.actor_num_envs, n_steps=2,
+            max_steps=20, v_min=-5.0, v_max=0.0, hidden=(16, 16), n_atoms=11)
+        obs_dim, act_dim, _ = infer_dims(acfg)
+        service = self._make_service(obs_dim=obs_dim, act_dim=act_dim)
+        receiver = self._make_receiver(service)
+        store = WeightStore()
+        store.publish(init_state(acfg.learner_config(obs_dim, act_dim),
+                                 jax.random.key(cfg.template_seed)
+                                 ).actor_params, step=0)
+        weight_server = WeightServer(store, host="127.0.0.1")
+        ctx = mp.get_context("spawn")
+        out_q = ctx.Queue()
+        procs = []
+        for i in range(cfg.n_actors):
+            p = ctx.Process(
+                target=_actor_lane_main,
+                args=(dataclasses.asdict(acfg), "127.0.0.1", receiver.port,
+                      weight_server.port, f"actor-{i}", ticks,
+                      cfg.send_timeout, cfg.max_retries, out_q),
+                daemon=True)
+            p.start()
+            procs.append(p)
+        t_start = time.monotonic()
+        steps0 = service.env_steps
+        summaries, deadlocks = [], 0
+        # real actors pay a jax+env import per process: generous budget
+        budget = 120.0 + ticks * cfg.actor_num_envs * 0.05
+        for _ in procs:
+            try:
+                summaries.append(out_q.get(timeout=budget))
+            except Exception:
+                deadlocks += 1
+        for p in procs:
+            p.join(timeout=10.0)
+            if p.is_alive():
+                p.terminate()
+        dt = time.monotonic() - t_start
+        _quiesce(service)
+        receiver.close()
+        weight_server.close()
+        service.flush(timeout=10.0)
+        rows_inserted = service.env_steps - steps0
+        stats = service.ingest_stats()
+        if stats["pending"] > 0 or not service._drain_thread.is_alive():
+            deadlocks += 1
+        service.close()
+        return {
+            "n_actors": cfg.n_actors,
+            "mode": "actor",
+            "actor_env": cfg.actor_env,
+            "num_envs": cfg.actor_num_envs,
+            "ticks_per_lane": ticks,
+            "duration_s": round(dt, 3),
+            "rows_inserted": int(rows_inserted),
+            "rows_per_sec": round(rows_inserted / dt, 1) if dt else 0.0,
+            "lane_env_steps": [s.get("env_steps", 0) for s in summaries],
+            "deadlocks": deadlocks,
+            "ingest_shards": cfg.ingest_shards,
+            "codec": cfg.resolved_codec(),
+            "ingest": {k: stats[k] for k in
+                       ("sheds", "shed_rows", "decode_errors",
+                        "order_breaks", "evictions", "readmissions")},
+        }
+
     # -- artifact ----------------------------------------------------------
     def _report(self, lanes: list[dict], rows_inserted: int, dt: float,
                 service_stats: dict, deadlocks: int, stalls: int) -> dict:
@@ -281,11 +420,16 @@ class FleetHarness:
         latencies = [v for lane in lanes for v in lane["latencies_ms"]]
         lane_recovery = [v for lane in lanes for v in lane["recovery_s"]]
         attempted = sum(lane["rows_attempted"] for lane in lanes)
+        rows_per_sec = round(rows_inserted / dt, 1) if dt else 0.0
         return {
             "n_actors": cfg.n_actors,
             "mode": cfg.mode,
+            "ingest_shards": cfg.ingest_shards,
+            "codec": cfg.resolved_codec(),
             "duration_s": round(dt, 3),
-            "rows_per_sec": round(rows_inserted / dt, 1) if dt else 0.0,
+            "rows_per_sec": rows_per_sec,
+            "rows_per_sec_per_shard": round(
+                rows_per_sec / cfg.ingest_shards, 1),
             "demand_rows_per_sec": round(cfg.demand_rows_per_sec(), 1),
             "rows_inserted": int(rows_inserted),
             "rows_attempted": int(attempted),
@@ -307,6 +451,9 @@ class FleetHarness:
             "evictions": service_stats["evictions"],
             "readmissions": service_stats["readmissions"],
             "service_recovery": _recovery_stats(service_stats["recovery_s"]),
+            "decode_errors": service_stats.get("decode_errors", 0),
+            "order_breaks": service_stats.get("order_breaks", 0),
+            "per_shard": service_stats.get("per_shard", []),
             "receiver_stalls": stalls,
             "deadlocks": deadlocks,
             "ticks": sum(lane["ticks"] for lane in lanes),

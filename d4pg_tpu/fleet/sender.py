@@ -81,6 +81,7 @@ class ThrottledSender:
         max_ticks: Optional[int] = None,
         stop: Optional[threading.Event] = None,
         connect_stagger_s: float = 0.0,
+        codec: str = "npz",
     ):
         self.actor_index = actor_index
         self.actor_id = actor_id
@@ -95,6 +96,7 @@ class ThrottledSender:
         self._max_ticks = max_ticks
         self._stop = stop if stop is not None else threading.Event()
         self._connect_stagger_s = connect_stagger_s
+        self._codec = codec
         # counters (absorbed across crash-replaced sender instances)
         self.ticks = 0
         self.rows_attempted = 0
@@ -120,6 +122,7 @@ class ThrottledSender:
             min_block=self._block_rows, max_block=self._block_rows,
             flush_interval=1e9, backoff_base=0.05, backoff_max=1.0,
             backoff_seed=self.chaos.config.seed * 100_003 + self.actor_index,
+            codec=self._codec,
         )
 
     def _absorb(self, sender: CoalescingSender) -> None:
@@ -237,3 +240,29 @@ def _process_lane_main(kwargs: dict, duration_s: float, out_queue) -> None:
     finally:
         timer.cancel()
         out_queue.put(lane.summary())
+
+
+def _actor_lane_main(cfg_kwargs: dict, host: str, transitions_port: int,
+                     weights_port: int, actor_id: str, max_ticks: int,
+                     send_timeout: float, max_retries, out_queue) -> None:
+    """Entry point for a REAL actor lane (``FleetHarness(mode='actor')``):
+    a spawned subprocess running the full ``actor_main.run_actor`` path —
+    env pool, policy inference, n-step folding, coalescing transport,
+    live weight pulls — against the harness's learner-side servers. CPU
+    backend forced before any jax import touches an accelerator; the
+    fleet-member degradation policy (shed-and-count) is on so a slow
+    receiver costs rows, not a wedged lane."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from d4pg_tpu.actor_main import run_actor
+    from d4pg_tpu.config import ExperimentConfig
+
+    steps = 0
+    try:
+        steps = run_actor(ExperimentConfig(**cfg_kwargs), host,
+                          transitions_port, weights_port, actor_id=actor_id,
+                          max_ticks=max_ticks, send_timeout=send_timeout,
+                          send_retries=max_retries, drop_on_timeout=True)
+    finally:
+        out_queue.put({"actor_id": actor_id, "env_steps": int(steps)})

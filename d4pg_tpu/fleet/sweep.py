@@ -73,8 +73,62 @@ def run_sweep(
             "send_timeout": base.send_timeout,
             "max_retries": base.max_retries,
             "mode": base.mode,
+            "ingest_shards": base.ingest_shards,
+            "codec": base.resolved_codec(),
             "chaos": dataclasses.asdict(chaos),
         },
+    }
+
+
+def shard_sweep(
+    ks=(1, 2, 4),
+    n_actors: int = 256,
+    duration_s: float = 10.0,
+    rows_per_sec: float = 60.0,
+    chaos: ChaosConfig | None = None,
+    **overrides,
+) -> dict:
+    """The multi-core receiver sweep: FIXED N, ingest shards K ∈ ``ks``.
+
+    Offered load is raised (default 60 rows/s/lane = 15,360 rows/s at
+    N=256) so the RECEIVER is the saturated stage — at PR 3's 20 rows/s
+    the sweep was offered-load-limited above ~5,120 and no receiver
+    change could show. ``codec='auto'``: the K=1 row runs the legacy npz
+    plane exactly as PR 3 shipped it (the ~5,200 rows/s/core baseline);
+    K≥2 rows run the sharded plane end to end (v2 raw frames, zero-decode
+    admission, shard-worker decode, ordered merge commit). Each row
+    reports ``rows_per_sec_per_shard``; the summary adds scaling
+    efficiency vs K=1 and vs the priced single-core ceiling."""
+    chaos = default_chaos() if chaos is None else chaos
+    rows = []
+    for k in ks:
+        cfg = FleetConfig(n_actors=int(n_actors), duration_s=duration_s,
+                          rows_per_sec=rows_per_sec, ingest_shards=int(k),
+                          chaos=chaos, **overrides)
+        result = FleetHarness(cfg).run()
+        result.pop("chaos_log", None)
+        rows.append(result)
+    base = rows[0]["rows_per_sec"] if rows else 0.0
+    return {
+        "n_actors": int(n_actors),
+        "rows_per_sec_per_actor": rows_per_sec,
+        "offered_rows_per_sec": round(n_actors * rows_per_sec, 1),
+        "single_core_ceiling_rows_per_sec": 5200.0,  # PR 2's priced value
+        "sweep": rows,
+        "scaling": [
+            {
+                "ingest_shards": r["ingest_shards"],
+                "rows_per_sec": r["rows_per_sec"],
+                "rows_per_sec_per_shard": r["rows_per_sec_per_shard"],
+                "speedup_vs_k1": (round(r["rows_per_sec"] / base, 2)
+                                  if base else None),
+                "efficiency": (round(r["rows_per_sec"]
+                                     / (base * r["ingest_shards"]), 2)
+                               if base else None),
+                "vs_ceiling": round(r["rows_per_sec"] / 5200.0, 2),
+            }
+            for r in rows
+        ],
     }
 
 
@@ -84,8 +138,17 @@ def main(argv=None):
     ap.add_argument("--seconds", type=float, default=10.0)
     ap.add_argument("--rows_per_sec", type=float, default=20.0)
     ap.add_argument("--block_rows", type=int, default=16)
-    ap.add_argument("--mode", choices=("thread", "process"),
+    ap.add_argument("--mode", choices=("thread", "process", "actor"),
                     default="thread")
+    ap.add_argument("--ingest_shards", type=int, default=1,
+                    help="receiver-side ingest shards K (SO_REUSEPORT "
+                         "listeners + K decode workers + ordered merge)")
+    ap.add_argument("--codec", choices=("auto", "npz", "raw"),
+                    default="auto")
+    ap.add_argument("--shards_sweep", type=int, nargs="+", default=None,
+                    metavar="K",
+                    help="run the fixed-N shard sweep over these K values "
+                         "instead of the N sweep (e.g. --shards_sweep 1 2 4)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--no_chaos", action="store_true",
                     help="clean-plane control run (all fault probs 0)")
@@ -94,9 +157,16 @@ def main(argv=None):
     ns = ap.parse_args(argv)
     chaos = (ChaosConfig(seed=ns.seed) if ns.no_chaos
              else default_chaos(ns.seed))
-    artifact = run_sweep(ns=tuple(ns.ns), duration_s=ns.seconds,
-                         chaos=chaos, rows_per_sec=ns.rows_per_sec,
-                         block_rows=ns.block_rows, mode=ns.mode)
+    if ns.shards_sweep:
+        artifact = shard_sweep(ks=tuple(ns.shards_sweep),
+                               n_actors=max(ns.ns), duration_s=ns.seconds,
+                               rows_per_sec=ns.rows_per_sec, chaos=chaos,
+                               block_rows=ns.block_rows, codec=ns.codec)
+    else:
+        artifact = run_sweep(ns=tuple(ns.ns), duration_s=ns.seconds,
+                             chaos=chaos, rows_per_sec=ns.rows_per_sec,
+                             block_rows=ns.block_rows, mode=ns.mode,
+                             ingest_shards=ns.ingest_shards, codec=ns.codec)
     if ns.out:
         with open(ns.out, "w") as f:
             json.dump(artifact, f, indent=2)

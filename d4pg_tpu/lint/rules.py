@@ -1,8 +1,8 @@
 """The jaxlint rule catalog.
 
-Six rule families, each targeting a hazard that silently costs throughput
-or correctness on this stack (see docs/architecture.md "Static analysis &
-perf sentinels" for the rationale and suppression policy):
+Seven rule families, each targeting a hazard that silently costs
+throughput or correctness on this stack (see docs/architecture.md "Static
+analysis & perf sentinels" for the rationale and suppression policy):
 
 - ``prng-key-reuse``       — same key consumed by two samplers
 - ``host-sync-in-jit``     — host/device sync points under a trace
@@ -10,6 +10,7 @@ perf sentinels" for the rationale and suppression policy):
 - ``use-after-donation``   — reading a buffer after ``donate_argnums`` took it
 - ``tracer-leak``          — mutating outer state from inside a trace
 - ``device-put-in-loop``   — per-item H2D transfers in a Python loop
+- ``lock-order``           — service/buffer lock acquired under a shard lock
 
 Every rule is a function ``(ModuleContext) -> list[Finding]`` registered in
 ``RULES``. Rules are deliberately conservative: a finding should be either
@@ -581,6 +582,92 @@ def rule_device_put_in_loop(ctx: ModuleContext) -> list[Finding]:
 
 
 # --------------------------------------------------------------------------
+# R7: lock-order
+# --------------------------------------------------------------------------
+
+# The sharded ingest plane's locking discipline (distributed/
+# replay_service.py): shard/ring locks are LEAF locks. The commit thread
+# holds the buffer or service lock and may wait for shard work to land;
+# a thread that takes the buffer/service lock while already inside a
+# shard/ring lock closes the classic ABBA cycle. Tiers by attribute name
+# (conservative: only these exact suffixes participate):
+_LEAF_LOCKS = {"cond", "_cond", "ring_lock", "shard_lock", "_ring_locks",
+               "_shard_locks"}
+_OUTER_LOCKS = {"_buffer_lock", "_lock", "_commit_cond"}
+
+
+def _lock_tier(expr: ast.expr) -> str | None:
+    """'leaf' / 'outer' / None for a with-item or .acquire() receiver."""
+    # unwrap subscripts: with self._ring_locks[i]: ...
+    while isinstance(expr, ast.Subscript):
+        expr = expr.value
+    name = last_part(dotted_name(expr) or "")
+    if name in _LEAF_LOCKS:
+        return "leaf"
+    if name in _OUTER_LOCKS:
+        return "outer"
+    return None
+
+
+def rule_lock_order(ctx: ModuleContext) -> list[Finding]:
+    """Flags acquiring a buffer/service-tier lock while holding a
+    shard/ring-tier (leaf) lock — the deadlock shape the sharded ingest
+    refactor introduces. Detects both ``with`` nesting and bare
+    ``.acquire()`` calls lexically inside a leaf ``with`` block, within
+    one function (cross-function flows are the suppression-documented
+    exception)."""
+    findings: list[Finding] = []
+
+    def emit(node, held: str):
+        findings.append(Finding(
+            ctx.path, node.lineno, node.col_offset, "lock-order",
+            f"outer-tier lock acquired while holding leaf lock '{held}' — "
+            "shard/ring locks are leaf locks; take the buffer/service "
+            "lock first or split the critical section"))
+
+    def scan(body: list[ast.stmt], held: str | None) -> None:
+        for stmt in body:
+            inner_held = held
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    tier = _lock_tier(item.context_expr)
+                    if tier == "outer" and held is not None:
+                        emit(item.context_expr, held)
+                    elif tier == "leaf":
+                        nm = last_part(
+                            dotted_name(
+                                item.context_expr.value
+                                if isinstance(item.context_expr,
+                                              ast.Subscript)
+                                else item.context_expr) or "")
+                        inner_held = nm or "leaf"
+                scan(stmt.body, inner_held)
+                continue
+            if isinstance(stmt, FunctionNode):
+                continue  # new scope, analyzed by its own pass
+            if held is not None:
+                for node in walk_own(stmt):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr == "acquire"
+                            and _lock_tier(node.func.value) == "outer"):
+                        emit(node, held)
+            # generic recursion into compound statements
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if isinstance(sub, list) and sub \
+                        and isinstance(sub[0], ast.stmt):
+                    scan(sub, held)
+            for handler in getattr(stmt, "handlers", []) or []:
+                scan(handler.body, held)
+
+    for func in all_functions(ctx):
+        scan(_body_of(func), None)
+    scan([s for s in ctx.tree.body if not isinstance(s, FunctionNode)], None)
+    return findings
+
+
+# --------------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------------
 
@@ -617,4 +704,8 @@ RULES: dict[str, Rule] = {r.id: r for r in [
          "jax.device_put called inside a Python loop — per-item H2D; "
          "coalesce into a block and transfer once",
          rule_device_put_in_loop),
+    Rule("lock-order",
+         "buffer/service lock acquired while holding a shard/ring leaf "
+         "lock — the sharded-ingest deadlock shape",
+         rule_lock_order),
 ]}

@@ -113,6 +113,24 @@ class HostStagingRing:
     def pop(self, n: int) -> None:
         self._r += n
 
+    def take(self, n: int) -> list[TransitionBatch]:
+        """Pop the ``n`` oldest staged rows as one or two per-field view
+        batches (two when the run wraps the ring boundary). Zero-copy;
+        the views are only valid until the writer laps the ring — the
+        multi-ring merge copies them onward immediately
+        (``staging.MultiRingStaging``)."""
+        n = min(n, len(self))
+        if n <= 0:
+            return []
+        off = self._r % self.size
+        first = min(n, self.size - off)
+        out = [TransitionBatch(*[a[off:off + first] for a in self._arrays])]
+        if first < n:
+            out.append(TransitionBatch(*[a[:n - first]
+                                         for a in self._arrays]))
+        self._r += n
+        return out
+
 
 class FusedDeviceReplay:
     """Fixed-capacity device ring + (optionally) device PER trees."""
@@ -128,6 +146,7 @@ class FusedDeviceReplay:
         device=None,
         block_rows: int | None = None,
         staging_blocks: int = 8,
+        ingest_shards: int = 1,
     ):
         self.capacity = int(capacity)
         obs_shape = (obs_dim,) if np.isscalar(obs_dim) else tuple(obs_dim)
@@ -150,11 +169,20 @@ class FusedDeviceReplay:
         # be overwritten by later drains
         n_blocks = max(2, min(int(staging_blocks),
                               -(-self.capacity // self.block_rows)))
-        self._staging = HostStagingRing(
-            [(obs_shape, obs_dtype), ((act_dim,), np.float32),
-             ((), np.float32), (obs_shape, obs_dtype), ((), np.float32),
-             ((), np.float32)],
-            self.block_rows, n_blocks)
+        specs = [(obs_shape, obs_dtype), ((act_dim,), np.float32),
+                 ((), np.float32), (obs_shape, obs_dtype), ((), np.float32),
+                 ((), np.float32)]
+        self.ingest_shards = max(1, int(ingest_shards))
+        if self.ingest_shards > 1:
+            # sharded ingest plane: K workers stage concurrently into
+            # private rings; the merge hands the SAME fixed-shape frame
+            # stream to stage_block/commit_staged (staging.MultiRingStaging)
+            from d4pg_tpu.replay.staging import MultiRingStaging
+
+            self._staging = MultiRingStaging(specs, self.block_rows,
+                                             n_blocks, self.ingest_shards)
+        else:
+            self._staging = HostStagingRing(specs, self.block_rows, n_blocks)
         self._inflight: tuple[TransitionBatch, int] | None = None
         self._commit = self._make_commit()
 
@@ -191,7 +219,25 @@ class FusedDeviceReplay:
         backlog could otherwise OOM the host."""
         if batch.obs.shape[0] == 0:
             return
-        self._staging.push(batch)
+        if self.ingest_shards > 1:
+            self._staging.push(batch, shard=0)
+        else:
+            self._staging.push(batch)
+
+    def add_sharded(self, batch: TransitionBatch, shard: int,
+                    ticket: int | None = None) -> None:
+        """Stage host rows into shard ``shard``'s private ring — the
+        concurrent half of the sharded ingest plane. Safe WITHOUT the
+        service buffer lock: each ring has a single pushing worker and
+        its own leaf lock against the learner's merge (the shard worker
+        call site in ``ReplayService._worker``). ``ticket`` orders the
+        merge; per-shard tickets must ascend (the admission seq does)."""
+        if batch.obs.shape[0] == 0:
+            return
+        if self.ingest_shards > 1:
+            self._staging.push(batch, shard=shard, ticket=ticket)
+        else:
+            self._staging.push(batch)
 
     def __len__(self) -> int:
         # staged + in-flight rows count toward warmup gates — they WILL be

@@ -5,13 +5,129 @@ the XLA learner step. ``DeviceStager`` keeps one batch in flight: while the
 TPU executes step t on batch t, the host samples and ``device_put``s batch
 t+1 (JAX dispatch is async, so ``device_put`` returns immediately and the
 transfer overlaps with compute).
+
+``MultiRingStaging`` is the host half of the SHARDED ingest plane: K
+private column-major staging rings (one per ingest shard, so K workers
+copy rows concurrently without sharing a cache line of bookkeeping) whose
+contents merge back into ONE fixed-shape frame stream for the existing
+single-``device_put`` + single-jitted-commit fused dispatch.
 """
 
 from __future__ import annotations
 
+import itertools
+import threading
+from collections import deque
 from typing import Callable, Iterator
 
 import jax
+
+
+class MultiRingStaging:
+    """K per-shard host staging rings + a ticket-ordered merge frame.
+
+    Interface-compatible with ``HostStagingRing`` on the consumer side
+    (``frame()``/``pop()``/``take()``/``__len__``), so the fused buffer's
+    ``stage_block``/``commit_staged``/``drain_per_row`` run unchanged on
+    the merged stream — the ≤1-device_put-per-block invariant and the
+    per-row bitwise oracle survive sharding untouched.
+
+    Ownership: shard ``i``'s worker is the only pusher of ring ``i``;
+    each ring (and its record deque) is guarded by one leaf lock, held
+    only for the slice-copy — never while taking any service or buffer
+    lock (the ``lock-order`` jaxlint rule enforces the direction).
+
+    Merge-commit ordering rule: every pushed batch carries a monotonic
+    admission ticket (per-ring ascending; globally unique). ``frame()``
+    refills an internal merge ring by repeatedly draining the record
+    with the SMALLEST ticket among the shard ring heads, so rows land on
+    the device in admission order whenever the plane is quiescent (the
+    bitwise K=1↔K=2 equivalence bar); rows still being decoded on a
+    straggler shard can be overtaken mid-flight — the merge never
+    blocks the learner's stage call on a slow shard.
+    """
+
+    def __init__(self, specs, block_rows: int, n_blocks: int,
+                 shards: int):
+        from d4pg_tpu.replay.fused_buffer import HostStagingRing
+
+        self.shards = max(1, int(shards))
+        self.block_rows = int(block_rows)
+        self._rings = [HostStagingRing(specs, block_rows, n_blocks)
+                       for _ in range(self.shards)]
+        self._ring_locks = [threading.Lock() for _ in range(self.shards)]
+        # per-ring (ticket, rows) records, ticket-ascending
+        self._records: list[deque] = [deque() for _ in range(self.shards)]
+        self._merge = HostStagingRing(specs, block_rows, 2)
+        self._ticket = itertools.count()
+
+    def __len__(self) -> int:
+        n = len(self._merge)
+        for i in range(self.shards):
+            with self._ring_locks[i]:
+                n += len(self._rings[i])
+        return n
+
+    # -- producer side (one worker per shard) ------------------------------
+    def push(self, batch, shard: int = 0, ticket: int | None = None) -> None:
+        i = shard % self.shards
+        ring, records = self._rings[i], self._records[i]
+        with self._ring_locks[i]:
+            t = next(self._ticket) if ticket is None else ticket
+            n = min(int(batch.obs.shape[0]), ring.size)
+            overflow = max(0, len(ring) + n - ring.size)
+            ring.push(batch)
+            # the ring dropped its oldest rows to admit these: trim the
+            # same rows off the oldest records so tickets stay aligned
+            # with ring contents
+            while overflow and records:
+                t0, n0 = records[0]
+                if n0 <= overflow:
+                    records.popleft()
+                    overflow -= n0
+                else:
+                    records[0] = (t0, n0 - overflow)
+                    overflow = 0
+            records.append((t, n))
+
+    # -- consumer side (learner thread) ------------------------------------
+    def _refill(self) -> None:
+        """Move rows into the merge ring, smallest head ticket first,
+        until it holds a full block or the shard rings run dry."""
+        while len(self._merge) < self.block_rows:
+            best = None
+            for i in range(self.shards):
+                with self._ring_locks[i]:
+                    if self._records[i]:
+                        t = self._records[i][0][0]
+                        if best is None or t < best[0]:
+                            best = (t, i)
+            if best is None:
+                return
+            _t, i = best
+            with self._ring_locks[i]:
+                if not self._records[i] or self._records[i][0][0] != _t:
+                    continue  # a push overflowed the head away; re-scan
+                _t, n = self._records[i].popleft()
+                room = self._merge.size - len(self._merge)
+                if n > room:
+                    # only part of the record fits this pass: keep the
+                    # remainder (same ticket) at the head for the next
+                    self._records[i].appendleft((_t, n - room))
+                    n = room
+                for piece in self._rings[i].take(n):
+                    self._merge.push(piece)
+
+    def frame(self):
+        self._refill()
+        return self._merge.frame()
+
+    def pop(self, n: int) -> None:
+        self._merge.pop(n)
+
+    def take(self, n: int):
+        self._refill()
+        return self._merge.take(n)
 
 
 class DeviceStager:

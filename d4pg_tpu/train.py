@@ -382,7 +382,8 @@ def train(cfg: ExperimentConfig) -> dict:
             from d4pg_tpu.envs.normalizer import RunningMeanStd
 
             obs_norm = RunningMeanStd(config.obs_dim, clip=cfg.normalize_clip)
-    service = ReplayService(buffer, obs_norm=obs_norm)
+    service = ReplayService(buffer, obs_norm=obs_norm,
+                            num_ingest_shards=cfg.ingest_shards)
 
     # --- io (process 0 owns all of it in multi-host mode) ----------------
     bus = MetricsBus(echo=is_main)
@@ -624,12 +625,20 @@ def train(cfg: ExperimentConfig) -> dict:
         from d4pg_tpu.distributed.transport import TransitionReceiver
         from d4pg_tpu.distributed.weight_server import WeightServer
 
+        # K>1: shard-aware receiver — frames forwarded undecoded to the
+        # owning ingest shard's worker (raw frames admit on header
+        # metadata; npz frames decode at admission, as before). Note the
+        # normalizer still folds on the single commit thread in ticket
+        # order, so sharding never changes the statistics stream.
         receiver = TransitionReceiver(
             lambda b, aid, count: service.add(b, actor_id=aid,
                                               count_env_steps=count),
             host=cfg.serve_host,
             port=cfg.serve_transitions_port,
             secret=cfg.serve_secret or None,
+            num_shards=cfg.ingest_shards,
+            on_payload=(service.add_payload if cfg.ingest_shards > 1
+                        else None),
         )
         weight_server = WeightServer(weights, host=cfg.serve_host,
                                      port=cfg.serve_weights_port,

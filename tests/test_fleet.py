@@ -181,7 +181,127 @@ def test_fleet_config_validation():
     assert FleetConfig(n_actors=4).demand_rows_per_sec() == 4 * 20.0
 
 
+def test_fleet_smoke_sharded_k2():
+    """The sharded receiver under the full tier-1 chaos mix: K=2 ingest
+    shards, v2 raw frames (codec auto-resolves), every fault kind firing
+    — zero deadlocks, zero merge order-breaks, and every shard's
+    counters consistent with the rows it owned."""
+    result = FleetHarness(_smoke_config(ingest_shards=2)).run()
+    assert result["ingest_shards"] == 2
+    assert result["codec"] == "raw"  # auto resolves to the v2 plane
+    assert result["deadlocks"] == 0
+    assert result["order_breaks"] == 0
+    assert result["decode_errors"] == 0
+    assert result["rows_inserted"] > 0
+    assert result["ticks"] == 8 * 12
+    assert result["rows_per_sec_per_shard"] == pytest.approx(
+        result["rows_per_sec"] / 2, abs=0.1)
+    shards = result["per_shard"]
+    assert [s["shard"] for s in shards] == [0, 1]
+    # per-shard admission accounting covers every delivered row
+    assert sum(s["rows_in"] for s in shards) >= result["rows_inserted"]
+    drops = result["drops"]
+    assert result["rows_inserted"] + drops["backpressure_rows"] \
+        + drops["shed_rows"] <= result["rows_attempted"]
+    assert result["crashes"] > 0 and drops["chaos_rows"] > 0
+
+
+def _scripted_feed(n_lanes: int, ticks: int, block_rows: int = 8,
+                   obs_dim: int = 6, act_dim: int = 2):
+    """The deterministic K-equivalence feed: the SAME seeded fleet script
+    (chaos decides which (lane, tick) blocks deliver), serialized in
+    canonical (tick, lane) order. Lane k's tick t block is seeded by
+    (k, t), so the feed is bit-reproducible."""
+    policy = ChaosPolicy(SMOKE_CHAOS)
+    streams = [policy.actor_stream(k, f"lane-{k}") for k in range(n_lanes)]
+    feed = []
+    for t in range(ticks):
+        for k, chaos in enumerate(streams):
+            ev = chaos.next()
+            if ev.kind in ("ok", "delay"):  # delivered blocks only
+                feed.append((k, synthetic_block(
+                    block_rows, obs_dim, act_dim, seed=1000 * k + t)))
+    return feed
+
+
+def test_fleet_k2_bitwise_replay_equivalence_vs_k1():
+    """Acceptance bar: the same seeded fleet script through a K=1 and a
+    K=2 service lands the IDENTICAL final buffer — same bytes in the
+    same slots, same env-step count — because the sharded plane's merge
+    commits in admission-ticket order (docs/architecture.md
+    "merge-commit ordering rules")."""
+    from d4pg_tpu.distributed.replay_service import ReplayService
+    from d4pg_tpu.replay.uniform import ReplayBuffer
+
+    feed = _scripted_feed(n_lanes=4, ticks=30)
+    assert len(feed) > 50  # the script actually delivered a fleet's worth
+    s1 = ReplayService(ReplayBuffer(100_000, 6, 2))
+    s2 = ReplayService(ReplayBuffer(100_000, 6, 2), num_ingest_shards=2)
+    for k, block in feed:
+        s1.add(block, actor_id=f"lane-{k}")
+        s2.add(block, actor_id=f"lane-{k}", shard=k % 2)
+    s1.flush(timeout=10.0)
+    s2.flush(timeout=10.0)
+    assert s1.env_steps == s2.env_steps == 8 * len(feed)
+    assert len(s1) == len(s2)
+    for field in ("obs", "action", "reward", "next_obs", "done",
+                  "discount"):
+        np.testing.assert_array_equal(
+            getattr(s1.buffer, field), getattr(s2.buffer, field))
+    assert s2.ingest_stats()["order_breaks"] == 0
+    s1.close()
+    s2.close()
+
+
+def test_fleet_actor_mode_smoke():
+    """The real-actor lane mode (ROADMAP gap: "harness drives the
+    transport slice"): N=2 lanes each spawn an actual ``actor_main``
+    subprocess — env pool, policy inference, live weight pulls — against
+    the harness's receiver + weight server, through the sharded (K=2)
+    ingest plane. Rows counted by the service must equal the env steps
+    the actors report (n-step folding holds a tail back per env)."""
+    cfg = _smoke_config(n_actors=2, max_ticks=8, mode="actor",
+                        ingest_shards=2, chaos=ChaosConfig(seed=1),
+                        send_timeout=5.0, heartbeat_timeout=30.0)
+    result = FleetHarness(cfg).run()
+    assert result["mode"] == "actor"
+    assert result["deadlocks"] == 0
+    assert len(result["lane_env_steps"]) == 2
+    # 8 ticks x 2 envs per lane of real interaction
+    assert all(s == 16 for s in result["lane_env_steps"])
+    # every delivered row is real actor data; the n-step folder (n=2)
+    # holds a warmup tail back per env, so inserted < env steps but must
+    # cover the bulk of the interaction
+    assert 0 < result["rows_inserted"] <= sum(result["lane_env_steps"])
+    assert result["rows_inserted"] >= sum(result["lane_env_steps"]) // 2
+    assert result["ingest"]["order_breaks"] == 0
+
+
 @pytest.mark.slow
+@pytest.mark.fleet
+def test_shard_sweep_slow():
+    """A bounded K ∈ {1, 2} shard sweep through the real sweep runner
+    (the full K ∈ {1, 2, 4} x N=256 version is ``python bench.py
+    --fleet``; its artifact is committed under docs/evidence/fleet/)."""
+    from d4pg_tpu.fleet import shard_sweep
+
+    artifact = shard_sweep(ks=(1, 2), n_actors=16, duration_s=2.0,
+                           rows_per_sec=200.0, chaos=SMOKE_CHAOS,
+                           obs_dim=24, act_dim=4, capacity=50_000,
+                           block_rows=16, heartbeat_timeout=0.5,
+                           evict_every_s=0.1, send_timeout=0.5)
+    assert [r["ingest_shards"] for r in artifact["sweep"]] == [1, 2]
+    assert [r["codec"] for r in artifact["sweep"]] == ["npz", "raw"]
+    for row in artifact["sweep"]:
+        assert row["deadlocks"] == 0
+        assert row["rows_per_sec"] > 0
+    scaling = artifact["scaling"]
+    assert scaling[0]["speedup_vs_k1"] == 1.0
+    assert all(s["vs_ceiling"] is not None for s in scaling)
+
+
+@pytest.mark.slow
+@pytest.mark.fleet
 def test_fleet_sweep_slow():
     """A bounded two-point sweep through the real sweep runner (the full
     {8..256} x 10 s version is ``python bench.py --fleet``; its artifact

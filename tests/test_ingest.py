@@ -171,6 +171,65 @@ def test_staging_ring_bounded_drops_oldest(rng):
     np.testing.assert_array_equal(got, want)
 
 
+# ------------------------------------------- sharded multi-ring staging ---
+
+def test_multi_ring_merge_bitwise_equals_single_ring_and_per_row(rng):
+    """The sharded staging plane (K private rings + ticket-ordered merge,
+    ``staging.MultiRingStaging``) must land EXACTLY the bytes and
+    priorities of the single-ring path AND the per-row oracle — the
+    merge-commit reorders nothing at quiescence."""
+    a = FusedDeviceReplay(96, OBS, ACT, block_rows=32)
+    b = FusedDeviceReplay(96, OBS, ACT, block_rows=32, ingest_shards=2)
+    c = FusedDeviceReplay(96, OBS, ACT, block_rows=32, ingest_shards=2)
+    for rnd in range(4):  # several rounds; the ring wraps capacity
+        for t, n in enumerate((13, 24, 7, 30, 9)):  # stays within staging
+            batch = _batch(rng, n)
+            ticket = rnd * 10 + t
+            a.add(batch)
+            b.add_sharded(batch, shard=t % 2, ticket=ticket)
+            c.add_sharded(batch, shard=t % 2, ticket=ticket)
+        assert a.drain() == b.drain() == c.drain_per_row()
+    assert (a.size, a.head) == (b.size, b.head) == (c.size, c.head)
+    for f in range(len(a.storage)):
+        np.testing.assert_array_equal(
+            np.asarray(a.storage[f][:96]), np.asarray(b.storage[f][:96]))
+        np.testing.assert_array_equal(
+            np.asarray(b.storage[f][:96]), np.asarray(c.storage[f][:96]))
+    np.testing.assert_array_equal(np.asarray(a.trees.sum_tree),
+                                  np.asarray(b.trees.sum_tree))
+    np.testing.assert_array_equal(np.asarray(b.trees.sum_tree),
+                                  np.asarray(c.trees.sum_tree))
+
+
+def test_service_direct_stage_k2_bitwise_equals_k1(rng):
+    """End to end through the service: a K=2 ``ReplayService`` over a
+    sharded fused buffer engages the direct-stage fast path (workers
+    copy rows into their own ring, no buffer lock) and must still land
+    the identical device state as the K=1 plane."""
+    f1 = FusedDeviceReplay(256, OBS, ACT, block_rows=32)
+    f2 = FusedDeviceReplay(256, OBS, ACT, block_rows=32, ingest_shards=2)
+    s1 = ReplayService(f1)
+    s2 = ReplayService(f2, num_ingest_shards=2)
+    assert s2._direct_stage, "direct-stage fast path must engage"
+    batches = [_batch(rng, n) for n in (8, 3, 16, 5, 12, 7, 9, 4)]
+    for i, b in enumerate(batches):
+        s1.add(b)
+        s2.add(b, shard=i % 2)
+    s1.flush()
+    s2.flush()
+    assert s1.drain_device() == s2.drain_device()
+    assert s1.env_steps == s2.env_steps
+    for f in range(len(f1.storage)):
+        np.testing.assert_array_equal(np.asarray(f1.storage[f][:64]),
+                                      np.asarray(f2.storage[f][:64]))
+    np.testing.assert_array_equal(np.asarray(f1.trees.sum_tree),
+                                  np.asarray(f2.trees.sum_tree))
+    stats = s2.ingest_stats()
+    assert sum(p["staged_rows"] for p in stats["per_shard"]) == 64
+    s1.close()
+    s2.close()
+
+
 # -------------------------------------------- transport coalescing --------
 
 def test_coalescing_sender_batches_frames(rng):

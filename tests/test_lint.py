@@ -302,7 +302,64 @@ def test_rule_catalog_covers_all_families():
     assert set(RULES) == {
         "prng-key-reuse", "host-sync-in-jit", "recompile-hazard",
         "use-after-donation", "tracer-leak", "device-put-in-loop",
+        "lock-order",
     }
+
+
+# ---------------------------------------------------------------- R7 ------
+
+def test_lock_order_fires_on_buffer_lock_under_shard_cond():
+    out = findings("""
+        class Service:
+            def bad(self, shard, batch):
+                with shard.cond:
+                    with self._buffer_lock:
+                        self.buffer.add(batch)
+        """, "lock-order")
+    assert len(out) == 1
+    assert "'cond'" in out[0].message
+
+
+def test_lock_order_fires_on_acquire_and_ring_locks():
+    out = findings("""
+        class Staging:
+            def bad(self, i):
+                with self._ring_locks[i]:
+                    self._lock.acquire()
+                    try:
+                        self.n += 1
+                    finally:
+                        self._lock.release()
+        """, "lock-order")
+    assert len(out) == 1
+
+
+def test_lock_order_clean_patterns():
+    # sequential (non-nested) acquisition and leaf-last nesting are the
+    # documented discipline — neither may fire
+    out = findings("""
+        class Service:
+            def good(self, shard, batch):
+                with shard.cond:
+                    shard.q.append(batch)
+                with self._buffer_lock:
+                    self.buffer.add(batch)
+                with self._lock:
+                    self.pending -= 1
+
+            def also_good(self, shard):
+                with self._buffer_lock:
+                    with shard.cond:
+                        return len(shard.q)
+
+            def new_scope_resets(self, shard):
+                with shard.cond:
+                    def helper(self):
+                        with self._buffer_lock:
+                            return 1  # different thread's scope
+                    return helper
+        """, "lock-order")
+    assert out == []
 
 
 def test_device_put_in_loop_fires():

@@ -260,6 +260,102 @@ def test_shed_disabled_keeps_block_contract():
     svc.close()
 
 
+def test_shed_watermark_at_k2_per_shard_counted_never_blocks():
+    """Regression at K=2 (ISSUE 4 satellite): the shed watermark is a
+    PER-SHARD contract — each shard sheds its own oldest, counts it
+    under its own lock, and the service totals close the conservation
+    equation exactly as at K=1."""
+    slow = _SlowBuffer(ReplayBuffer(10_000, 4, 2), delay_s=0.05)
+    svc = ReplayService(slow, ingest_capacity=4, shed_watermark=0.5,
+                        num_ingest_shards=2)
+    t0 = time.monotonic()
+    for i in range(12):
+        # never blocks, always True — the watermark sheds instead
+        assert svc.add(_batch(seed=i), actor_id=f"a{i % 2}",
+                       block=False, shard=i % 2) is True
+    assert time.monotonic() - t0 < 1.0
+    svc.flush(timeout=10.0)
+    stats = svc.ingest_stats()
+    assert stats["sheds"] > 0
+    assert stats["shed_rows"] == 8 * stats["sheds"]
+    # conservation: every accepted batch was committed or counted shed
+    assert slow.inserted_batches + stats["sheds"] == 12
+    assert svc.env_steps == 8 * slow.inserted_batches
+    assert stats["pending"] == 0
+    assert stats["order_breaks"] == 0
+    # the per-shard ledgers sum to the service totals
+    per = stats["per_shard"]
+    assert len(per) == 2
+    assert sum(p["sheds"] for p in per) == stats["sheds"]
+    assert sum(p["rows_in"] for p in per) == 12 * 8
+    svc.close()
+
+
+def test_crash_readmission_at_k2():
+    """Regression at K=2: eviction/re-admission bookkeeping is global
+    across shards — an actor owned by shard 1 that dies and later
+    streams through shard 0 is re-admitted, not double-counted."""
+    svc = ReplayService(ReplayBuffer(100, 4, 2), heartbeat_timeout=0.05,
+                        num_ingest_shards=2)
+    svc.add(_batch(), actor_id="a1", shard=1)
+    time.sleep(0.1)
+    assert svc.evict_dead() == ["a1"]
+    assert svc.dead_actors() == ["a1"]
+    svc.add(_batch(), actor_id="a1", shard=0)  # restarts on another shard
+    assert svc.dead_actors() == []
+    stats = svc.ingest_stats()
+    assert stats["evictions"] == 1 and stats["readmissions"] == 1
+    assert len(stats["recovery_s"]) == 1 and stats["recovery_s"][0] > 0
+    svc.flush()
+    assert len(svc) == 16
+    svc.close()
+
+
+def test_raw_codec_bitwise_matches_npz():
+    """The v2 raw frame must decode to exactly what the npz frame does:
+    same arrays, dtypes, actor id and count flag — it is a wire-format
+    change, not a semantic one."""
+    from d4pg_tpu.distributed.transport import (
+        _HEADER, _decode, _encode, decode_raw, encode_raw, raw_frame_meta)
+
+    batch = _batch(n=16, seed=9)
+    for count in (True, False):
+        raw = encode_raw("actor-x", batch, count)[_HEADER.size:]
+        npz = _encode("actor-x", batch, count)[_HEADER.size:]
+        aid_r, got_r, cnt_r = decode_raw(raw)
+        aid_n, got_n, cnt_n = _decode(npz)
+        assert aid_r == aid_n == "actor-x"
+        assert cnt_r == cnt_n == count
+        for r, n in zip(got_r, got_n):
+            assert r.dtype == n.dtype
+            np.testing.assert_array_equal(r, n)
+        # the header-only metadata path (zero-decode admission) agrees
+        assert raw_frame_meta(raw) == ("actor-x", 16, count)
+
+
+def test_payload_decode_error_tombstoned_not_wedged():
+    """A corrupt raw payload admitted to a shard must be counted
+    (decode_errors) and tombstoned — later frames still commit in order
+    instead of the merge wedging behind the dead ticket."""
+    from d4pg_tpu.distributed.transport import _HEADER, encode_raw
+
+    svc = ReplayService(ReplayBuffer(1000, 4, 2), num_ingest_shards=2,
+                        shed_watermark=0.9)
+    good = encode_raw("a0", _batch(), True)[_HEADER.size:]
+    # intact header (admission metadata parses fine) but truncated
+    # columns: the failure surfaces at WORKER decode, after admission
+    corrupt = good[:-50]
+    assert svc.add_payload(good, shard=0, codec="raw") is True
+    assert svc.add_payload(corrupt, shard=1, codec="raw") is True
+    assert svc.add_payload(good, shard=1, codec="raw") is True
+    svc.flush(timeout=10.0)
+    stats = svc.ingest_stats()
+    assert svc.env_steps == 16  # both good frames landed
+    assert stats["decode_errors"] >= 1
+    assert stats["pending"] == 0
+    svc.close()
+
+
 def test_sender_backoff_jitter_seeded_reproducible():
     """Seeded backoff jitter draws an identical schedule — the fleet
     harness's reproducibility reaches into the retry path."""
