@@ -67,7 +67,8 @@ class _IngestShard:
     describe, so ``snapshot()`` is consistent by construction."""
 
     __slots__ = ("idx", "capacity", "shed_at", "cond", "q", "sheds",
-                 "shed_rows", "decode_errors", "rows_in", "staged_rows")
+                 "shed_rows", "decode_errors", "rows_in", "staged_rows",
+                 "admit_fails")
 
     def __init__(self, idx: int, capacity: int, shed_at: int | None):
         self.idx = idx
@@ -83,6 +84,7 @@ class _IngestShard:
         self.decode_errors = 0
         self.rows_in = 0
         self.staged_rows = 0
+        self.admit_fails = 0  # rejected admissions (full past timeout)
 
     def snapshot(self) -> dict:
         with self.cond:
@@ -94,6 +96,7 @@ class _IngestShard:
                 "decode_errors": self.decode_errors,
                 "rows_in": self.rows_in,
                 "staged_rows": self.staged_rows,
+                "admit_fails": self.admit_fails,
             }
 
 
@@ -126,6 +129,14 @@ class ReplayService:
         # their policy input via the weight channel.
         self.obs_norm = obs_norm
         self.num_ingest_shards = max(1, int(num_ingest_shards))
+        buf_shards = getattr(buffer, "ingest_shards", 1)
+        if buf_shards not in (1, self.num_ingest_shards):
+            # a mismatched sharded buffer would hand one staging ring two
+            # pushing workers with interleaved tickets, breaking the
+            # per-ring ticket-ascending assumption of the merge commit
+            raise ValueError(
+                f"buffer.ingest_shards={buf_shards} must be 1 or match "
+                f"num_ingest_shards={self.num_ingest_shards}")
         self._env_steps = 0
         self._lock = threading.Lock()
         # Guards ALL buffer mutation/reads: the commit thread's insert
@@ -223,9 +234,15 @@ class ReplayService:
         count come from ``raw_frame_meta`` — and decoded later on the
         owning shard's worker; npz frames carry no cheap header, so they
         are decoded here (the connection thread, exactly where the
-        unsharded receiver decodes them). Never blocks: the sharded plane
-        always runs with a shed watermark contract (a full shard sheds
-        oldest, counted)."""
+        unsharded receiver decodes them).
+
+        Backpressure matches the unsharded receiver's: with a shed
+        watermark configured (fleet plane) admission never blocks — a
+        full shard sheds oldest, counted; WITHOUT one (train.py default)
+        a full shard blocks this connection thread up to 5 s, and a
+        frame rejected past the timeout is counted in the shard's
+        ``admit_fails`` rather than vanishing. A learner stall therefore
+        backs pressure up into the sender exactly as at K=1."""
         if codec == "raw":
             try:
                 actor_id, n, count = raw_frame_meta(payload)
@@ -249,7 +266,7 @@ class ReplayService:
         if n == 0:
             return True
         return self._admit(s, data, codec, actor_id, n, count,
-                           block=False, timeout=None)
+                           block=s.shed_at is None, timeout=5.0)
 
     def _route(self, actor_id: str, shard: int | None) -> _IngestShard:
         if shard is not None:
@@ -297,6 +314,8 @@ class ReplayService:
                 s.q.append((seq, data, codec, actor_id, rows, count))
                 s.rows_in += rows
                 s.cond.notify_all()
+            else:
+                s.admit_fails += 1
         if shed_seqs:
             self._tombstone(shed_seqs)
         dropped = shed_batches + (0 if admitted else 1)
@@ -505,6 +524,7 @@ class ReplayService:
             "sheds": sum(p["sheds"] for p in per_shard),
             "shed_rows": sum(p["shed_rows"] for p in per_shard),
             "decode_errors": sum(p["decode_errors"] for p in per_shard),
+            "admit_fails": sum(p["admit_fails"] for p in per_shard),
             "num_ingest_shards": self.num_ingest_shards,
             "commit_backlog": commit_backlog,
             "order_breaks": order_breaks,
@@ -576,15 +596,29 @@ class ReplayService:
                 with self._lock:
                     self._pending -= len(dead)
 
-    def _pop_ready(self, group: list) -> None:
+    def _pop_ready(self, group: list) -> int:
         """Pop the next run of in-ticket-order items (caller holds
-        ``_commit_cond``). Tombstoned tickets are consumed and skipped."""
+        ``_commit_cond``). Tombstoned tickets are consumed and skipped.
+
+        Returns the number of STALE tickets discarded: a ticket the
+        order-break valve advanced past (its worker held the popped group
+        too long) later lands at the head of its shard's deque with
+        ``seq < _next_seq`` — forever unpoppable by the equality match
+        below, which would gate that shard's worker on a never-emptying
+        inbox and wedge the shard permanently. Degrade-and-count instead:
+        drop it, count it in ``order_breaks``; the caller settles its
+        ``_pending`` accounting outside this condition."""
+        stale = 0
         while len(group) < self._COALESCE:
             while self._next_seq in self._skip:
                 self._skip.discard(self._next_seq)
                 self._next_seq += 1
             found = None
             for dq in self._out:
+                while dq and dq[0][0] < self._next_seq:
+                    dq.popleft()
+                    self.order_breaks += 1
+                    stale += 1
                 if dq and dq[0][0] == self._next_seq:
                     found = dq.popleft()
                     break
@@ -592,6 +626,7 @@ class ReplayService:
                 break
             group.append(found)
             self._next_seq += 1
+        return stale
 
     def _commit_loop(self) -> None:
         """The single writer of replay state: ordered K-way merge of the
@@ -601,16 +636,22 @@ class ReplayService:
         while True:
             group: list = []
             with self._commit_cond:
-                self._pop_ready(group)
+                stale = self._pop_ready(group)
                 if not group:
                     if self._stop.is_set():
                         return
                     self._commit_cond.wait(timeout=0.1)
-                    self._pop_ready(group)
-                if group:
+                    stale += self._pop_ready(group)
+                if group or stale:
                     # inbox slots freed: wake gated shard workers
                     self._commit_cond.notify_all()
                 backlog = any(self._out[i] for i in range(len(self._out)))
+            if stale:
+                # discarded tickets never reach _insert_group; settle the
+                # flush() accounting here (never inside _commit_cond —
+                # lock order: _lock is not taken under the merge cond)
+                with self._lock:
+                    self._pending -= stale
             if group:
                 last_progress = time.monotonic()
                 self._insert_group(group)
@@ -624,6 +665,11 @@ class ReplayService:
                     if heads and min(heads) > self._next_seq:
                         self.order_breaks += 1
                         self._next_seq = min(heads)
+                        # tombstones below the new floor can never be
+                        # consumed by _pop_ready's equality walk; prune
+                        # them or the set grows for the service lifetime
+                        self._skip = {t for t in self._skip
+                                      if t >= self._next_seq}
                 last_progress = time.monotonic()
 
     def _insert_group(self, group: list) -> None:

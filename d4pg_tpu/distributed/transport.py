@@ -688,8 +688,12 @@ class TransitionReceiver(ConnRegistry):
                         continue
                     actor_id, batch, count = decode_frame(payload, codec)
                     self._on_batch(batch, actor_id, count)
-        except (OSError, ProtocolError):
-            return  # peer died mid-frame / corrupt stream; just drop it
+        except (OSError, ProtocolError, struct.error, ValueError, TypeError):
+            # peer died mid-frame / corrupt stream; just drop it. The
+            # non-ProtocolError types come out of decode_frame on a
+            # hostile-but-well-framed payload (_raw_header unpack,
+            # np.dtype on a garbage name, UnicodeDecodeError ⊂ ValueError)
+            return
         finally:
             self._unregister_conn(conn)
 

@@ -346,10 +346,15 @@ def train(cfg: ExperimentConfig) -> dict:
     elif fused:
         from d4pg_tpu.replay.fused_buffer import FusedDeviceReplay
 
+        # ingest_shards must match the service's K: the shard workers
+        # direct-stage into per-shard rings, so a lone ring would get K
+        # pushers with interleaved tickets (merge assumes per-ring
+        # ticket-ascending) — ReplayService.__init__ asserts agreement
         buffer = FusedDeviceReplay(cfg.memory_size, obs_dim, act_dim,
                                    alpha=cfg.per_alpha,
                                    prioritized=cfg.prioritized_replay,
-                                   obs_dtype=obs_dtype)
+                                   obs_dtype=obs_dtype,
+                                   ingest_shards=cfg.ingest_shards)
     elif cfg.prioritized_replay:
         buffer = PrioritizedReplayBuffer(cfg.memory_size, obs_dim, act_dim,
                                          alpha=cfg.per_alpha, seed=cfg.seed,

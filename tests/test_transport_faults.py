@@ -373,3 +373,127 @@ def test_sender_backoff_jitter_seeded_reproducible():
     assert failing_schedule(5) == failing_schedule(5)
     assert failing_schedule(5) != failing_schedule(6)
     recv.close()
+
+
+def test_add_payload_without_watermark_blocks_not_drops():
+    """REVIEW regression (high): with NO shed watermark (train.py's
+    default wiring) a full ingest shard must give the sharded receiver
+    the same blocking backpressure the K=1 path has — a learner stall
+    must never silently discard frames off add_payload."""
+    from d4pg_tpu.distributed.transport import _HEADER, encode_raw
+
+    slow = _SlowBuffer(ReplayBuffer(10_000, 4, 2), delay_s=0.01)
+    svc = ReplayService(slow, ingest_capacity=2, num_ingest_shards=2)
+    frames = [encode_raw(f"a{i % 2}", _batch(seed=i), True)[_HEADER.size:]
+              for i in range(16)]
+    # far past per-shard capacity: pre-fix, the non-blocking admission
+    # returned False on a full deque and the frame vanished uncounted
+    results = [svc.add_payload(f, shard=i % 2, codec="raw")
+               for i, f in enumerate(frames)]
+    assert all(results)  # blocking admission absorbed the burst
+    svc.flush(timeout=10.0)
+    stats = svc.ingest_stats()
+    assert svc.env_steps == 16 * 8  # every frame landed
+    assert stats["sheds"] == 0
+    assert stats["admit_fails"] == 0
+    assert stats["pending"] == 0
+    svc.close()
+
+
+def test_stale_ticket_below_merge_floor_discarded_not_wedged():
+    """REVIEW regression (medium): a ticket the order-break valve
+    skipped past (worker held its group through the grace) later lands
+    at the head of its shard's outbox with seq < the merge floor. It
+    must be discarded and counted — not left as a forever-unpoppable
+    head that gates the shard's worker and wedges flush()/close()."""
+    import itertools
+
+    svc = ReplayService(ReplayBuffer(1000, 4, 2), num_ingest_shards=2)
+    b = _batch()
+    with svc._lock:
+        svc._pending += 2
+    with svc._commit_cond:
+        svc._next_seq = 5  # the valve already advanced past ticket 3
+        svc._seq = itertools.count(6)
+        svc._out[0].append((3, "a0", b, 8, True))  # the late ticket
+        svc._out[1].append((5, "a1", b, 8, True))  # current floor head
+        svc._commit_cond.notify_all()
+    svc.flush(timeout=5.0)
+    stats = svc.ingest_stats()
+    assert stats["pending"] == 0  # flush drained — no wedge
+    assert stats["order_breaks"] >= 1  # the discard was counted
+    assert svc.env_steps == 8  # only the floor ticket committed
+    assert len(svc) == 8
+    with svc._commit_cond:
+        assert not svc._out[0]  # the stale head is gone, worker ungated
+    svc.close()
+
+
+def test_order_break_valve_prunes_stale_tombstones(monkeypatch):
+    """REVIEW regression (low): when the safety valve advances the merge
+    floor, tombstones below it can never be consumed by the equality
+    walk — they must be pruned, not accumulate for the service
+    lifetime."""
+    import itertools
+
+    import d4pg_tpu.distributed.replay_service as rs
+
+    monkeypatch.setattr(rs, "_ORDER_GRACE_S", 0.2)
+    svc = ReplayService(ReplayBuffer(1000, 4, 2), num_ingest_shards=2)
+    b = _batch()
+    with svc._lock:
+        svc._pending += 1
+    with svc._commit_cond:
+        svc._skip.update({1, 2})  # tombstones below the coming jump
+        svc._seq = itertools.count(8)
+        svc._out[0].append((7, "a0", b, 8, True))  # tickets 0-6 vanished
+        svc._commit_cond.notify_all()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and svc.env_steps < 8:
+        time.sleep(0.02)
+    assert svc.env_steps == 8  # the valve skipped ahead and committed
+    stats = svc.ingest_stats()
+    assert stats["order_breaks"] >= 1
+    assert stats["pending"] == 0
+    with svc._commit_cond:
+        assert not svc._skip  # pruned at the jump, not grown forever
+    svc.close()
+
+
+def test_corrupt_v2_frame_drops_connection_without_thread_crash():
+    """REVIEW regression (low): a well-framed but hostile v2 payload
+    raises struct.error/UnicodeDecodeError (not ProtocolError) out of
+    decode_raw; the unsharded serve loop must drop the connection
+    silently — not die with an unhandled-exception traceback — and keep
+    serving new connections."""
+    import socket as socket_mod
+
+    from d4pg_tpu.distributed.transport import _HEADER, _MAGIC_RAW
+
+    crashes = []
+    orig_hook = threading.excepthook
+    threading.excepthook = lambda a: crashes.append(a)
+    try:
+        received = []
+        recv = TransitionReceiver(lambda b, aid, c: received.append(b),
+                                  host="127.0.0.1")
+        c = socket_mod.create_connection(("127.0.0.1", recv.port))
+        # valid frame header; body parses as count=255, actor-id length
+        # 255 and then UnicodeDecodeError on the \xff actor-id bytes
+        garbage = b"\xff" * 64
+        c.sendall(_HEADER.pack(_MAGIC_RAW, len(garbage)) + garbage)
+        c.settimeout(5.0)
+        assert c.recv(1) == b""  # server dropped the connection...
+        c.close()
+        # ...and the plane still serves: a fresh sender lands a frame
+        sender = TransitionSender("127.0.0.1", recv.port, actor_id="ok")
+        assert sender.send(_batch()) is True
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not received:
+            time.sleep(0.02)
+        assert len(received) == 1
+        assert not crashes  # serve thread exited cleanly, no traceback
+        sender.close()
+        recv.close()
+    finally:
+        threading.excepthook = orig_hook
