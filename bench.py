@@ -312,8 +312,15 @@ def bench_fleet(ns=(8, 32, 64, 128, 256), duration_s: float = 10.0,
     N=max(ns) with offered load raised to ``shard_rows_per_sec`` per lane
     so the RECEIVER saturates — rows/s-per-shard, scaling efficiency and
     the margin over the old ~5,200 rows/s single-core ceiling are
-    recorded per K. Invoked standalone as ``python bench.py --fleet``
-    (persists the artifact under docs/evidence/fleet/)."""
+    recorded per K. Every row also carries a ``locks`` block (the
+    ``core/locking.py`` tier sentinels run armed through the whole
+    sweep): per-tier acquisitions/contended/wait_ns/max_hold_ns and the
+    hierarchy-violation count — must be 0 in every committed artifact —
+    and the shard-sweep scaling table rolls the waits up as
+    ``lock_wait_ms`` per K, so a multi-core K-sweep can attribute flat
+    scaling to lock contention instead of guessing. Invoked standalone
+    as ``python bench.py --fleet`` (persists the artifact under
+    docs/evidence/fleet/)."""
     from d4pg_tpu.fleet.chaos import ChaosConfig
     from d4pg_tpu.fleet.sweep import default_chaos, run_sweep, shard_sweep
 

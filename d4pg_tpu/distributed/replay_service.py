@@ -30,11 +30,16 @@ of one. Ownership model:
   - the **learner thread** stays the single owner of device handles
     (``stage_block``/``commit_staged``), exactly as before.
 
-Lock order (enforced by the ``lock-order`` jaxlint rule): a shard
-condition is a LEAF lock — neither the buffer lock nor the service lock
-may be acquired while holding one. The commit thread acquires
-``_buffer_lock`` and ``_lock`` sequentially, never nested inside a shard
-condition.
+Lock order: every lock here is a ``core.locking`` tiered object from the
+ONE declared hierarchy (service > buffer > commit > shard > ring;
+monotone tier descent per thread). A shard condition is a LEAF lock —
+neither the buffer lock, the service lock nor the merge condition may be
+acquired while holding one. The commit thread acquires ``_buffer_lock``
+and ``_lock`` sequentially, never nested inside a shard condition. The
+discipline is enforced three ways: syntactically by the ``lock-order``
+jaxlint rule, interprocedurally by the ``lock-cycle`` lock-graph pass
+(``python -m d4pg_tpu.lint --locks``), and at runtime by the tier
+assertions the fleet chaos smoke runs with (``core/locking.py``).
 """
 
 from __future__ import annotations
@@ -47,6 +52,7 @@ from typing import Optional
 
 import numpy as np
 
+from d4pg_tpu.core.locking import TieredCondition, TieredLock
 from d4pg_tpu.distributed.transport import decode_frame, raw_frame_meta
 from d4pg_tpu.replay.prioritized import PrioritizedReplayBuffer
 from d4pg_tpu.replay.uniform import ReplayBuffer, TransitionBatch
@@ -74,7 +80,7 @@ class _IngestShard:
         self.idx = idx
         self.capacity = capacity
         self.shed_at = shed_at
-        self.cond = threading.Condition()
+        self.cond = TieredCondition("shard")
         # items: (seq, data, codec, actor_id, rows, count); codec None
         # means ``data`` is an already-decoded TransitionBatch, else it is
         # the undecoded wire payload for ``decode_frame(data, codec)``
@@ -138,11 +144,11 @@ class ReplayService:
                 f"buffer.ingest_shards={buf_shards} must be 1 or match "
                 f"num_ingest_shards={self.num_ingest_shards}")
         self._env_steps = 0
-        self._lock = threading.Lock()
+        self._lock = TieredLock("service")
         # Guards ALL buffer mutation/reads: the commit thread's insert
         # races the learner thread's sample()/update_priorities()
         # otherwise (segment-tree aggregates are multi-word updates).
-        self._buffer_lock = threading.Lock()
+        self._buffer_lock = TieredLock("buffer")
         # Batches accepted into a shard but not yet committed; counted on
         # the producer side so flush() can't slip through the window
         # between queue-pop and buffer insert.
@@ -180,7 +186,7 @@ class ReplayService:
         # Ordered merge state, all under _commit_cond: per-shard output
         # deques (seq-ascending by construction), tombstoned tickets, and
         # the next ticket to commit.
-        self._commit_cond = threading.Condition()
+        self._commit_cond = TieredCondition("commit")
         self._out: list[deque] = [deque() for _ in self._shards]
         self._skip: set[int] = set()
         self._next_seq = 0

@@ -29,6 +29,7 @@ import time
 
 import numpy as np
 
+from d4pg_tpu.core import locking
 from d4pg_tpu.distributed.replay_service import ReplayService
 from d4pg_tpu.distributed.transport import TransitionReceiver
 from d4pg_tpu.fleet.chaos import ChaosConfig, ChaosPolicy, StallGate
@@ -70,6 +71,13 @@ class FleetConfig:
     # frames at K=1 — so a K=1 sweep row measures the plane exactly as
     # PR 3 shipped it.
     codec: str = "auto"
+    # Run the receiver's tiered locks (core/locking.py) with hierarchy
+    # assertions in RECORD mode + contention counting: the report gains a
+    # ``locks`` block (per-tier acquisitions/contended/wait_ns/max_hold_ns
+    # and the hierarchy-violation count, which every committed artifact
+    # must show as 0). Record mode, not raise: a raise inside a shard
+    # worker would read as a deadlock instead of a named violation.
+    lock_debug: bool = True
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
     template_seed: int = 0
     connect_stagger_s: float = 0.002  # per-lane offset on the connect storm
@@ -141,6 +149,27 @@ class FleetHarness:
         self.config = config
         self.policy = ChaosPolicy(config.chaos)
 
+    # -- lock sentinels ----------------------------------------------------
+    def _arm_lock_sentinels(self) -> None:
+        if self.config.lock_debug:
+            locking.reset_stats()
+            locking.enable_debug(raise_on_violation=False)
+
+    def _lock_report(self) -> dict | None:
+        """Snapshot + disarm. ``per_lock`` keys are tier names (all shard
+        conditions fold into ``shard``, etc.); ``wait_ns`` is contended
+        acquisition time — the number that attributes fleet time to lock
+        waits in the K-sweep artifact."""
+        if not self.config.lock_debug:
+            return None
+        report = {
+            "hierarchy_violations": locking.violation_count(),
+            "violation_samples": locking.hierarchy_violations()[:4],
+            "per_lock": locking.lock_stats(),
+        }
+        locking.disable_debug()
+        return report
+
     # -- shared receiver construction --------------------------------------
     def _make_service(self, obs_dim: int | None = None,
                       act_dim: int | None = None) -> ReplayService:
@@ -188,6 +217,7 @@ class FleetHarness:
             return self._run_processes()
         if cfg.mode == "actor":
             return self._run_actors()
+        self._arm_lock_sentinels()
         service = self._make_service()
         gate = StallGate()
         receiver = self._make_receiver(service, gate)
@@ -269,7 +299,7 @@ class FleetHarness:
         return self._report(lanes=[lane.summary() for lane in lanes],
                             rows_inserted=rows_inserted, dt=dt,
                             service_stats=stats, deadlocks=deadlocks,
-                            stalls=gate.stalls)
+                            stalls=gate.stalls, locks=self._lock_report())
 
     # -- process mode ------------------------------------------------------
     def _run_processes(self) -> dict:
@@ -278,6 +308,7 @@ class FleetHarness:
         from d4pg_tpu.fleet.sender import _process_lane_main
 
         cfg = self.config
+        self._arm_lock_sentinels()
         service = self._make_service()
         receiver = self._make_receiver(service)
         ctx = mp.get_context("spawn")
@@ -324,7 +355,7 @@ class FleetHarness:
         service.close()
         return self._report(lanes=summaries, rows_inserted=rows_inserted,
                             dt=dt, service_stats=stats, deadlocks=deadlocks,
-                            stalls=0)
+                            stalls=0, locks=self._lock_report())
 
     # -- real-actor mode ---------------------------------------------------
     def _run_actors(self) -> dict:
@@ -347,6 +378,7 @@ class FleetHarness:
         from d4pg_tpu.train import infer_dims
 
         cfg = self.config
+        self._arm_lock_sentinels()
         ticks = cfg.max_ticks if cfg.max_ticks is not None else 30
         acfg = ExperimentConfig(
             env=cfg.actor_env, num_envs=cfg.actor_num_envs, n_steps=2,
@@ -398,6 +430,7 @@ class FleetHarness:
         return {
             "n_actors": cfg.n_actors,
             "mode": "actor",
+            "locks": self._lock_report(),
             "actor_env": cfg.actor_env,
             "num_envs": cfg.actor_num_envs,
             "ticks_per_lane": ticks,
@@ -415,7 +448,8 @@ class FleetHarness:
 
     # -- artifact ----------------------------------------------------------
     def _report(self, lanes: list[dict], rows_inserted: int, dt: float,
-                service_stats: dict, deadlocks: int, stalls: int) -> dict:
+                service_stats: dict, deadlocks: int, stalls: int,
+                locks: dict | None = None) -> dict:
         cfg = self.config
         latencies = [v for lane in lanes for v in lane["latencies_ms"]]
         lane_recovery = [v for lane in lanes for v in lane["recovery_s"]]
@@ -456,6 +490,7 @@ class FleetHarness:
             "per_shard": service_stats.get("per_shard", []),
             "receiver_stalls": stalls,
             "deadlocks": deadlocks,
+            "locks": locks,
             "ticks": sum(lane["ticks"] for lane in lanes),
             "chaos": dataclasses.asdict(cfg.chaos),
             "seed": cfg.chaos.seed,

@@ -126,10 +126,26 @@ def shard_sweep(
                                      / (base * r["ingest_shards"]), 2)
                                if base else None),
                 "vs_ceiling": round(r["rows_per_sec"] / 5200.0, 2),
+                # per-K lock-wait attribution (core/locking.py sentinels):
+                # on a multi-core receiver host, flat rows/s with rising
+                # lock_wait_ms fingers contention, not CPU, as the limit
+                "lock_wait_ms": _lock_wait_ms(r),
+                "hierarchy_violations": (
+                    r["locks"]["hierarchy_violations"]
+                    if r.get("locks") else None),
             }
             for r in rows
         ],
     }
+
+
+def _lock_wait_ms(row: dict) -> float | None:
+    """Total contended-acquisition wait across every tiered lock."""
+    locks = row.get("locks")
+    if not locks:
+        return None
+    return round(sum(per["wait_ns"]
+                     for per in locks["per_lock"].values()) / 1e6, 3)
 
 
 def main(argv=None):

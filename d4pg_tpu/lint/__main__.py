@@ -2,6 +2,10 @@
 
 Exit code 0 when every finding is suppressed (or none exist), 1 otherwise.
 With no paths, lints the ``d4pg_tpu`` package itself.
+
+``--locks`` prints the discovered whole-program lock graph (nodes, edges
+with witness sites, cycles) instead of findings — the review artifact
+for concurrency-touching PRs; exit 1 iff the graph has a cycle.
 """
 
 from __future__ import annotations
@@ -10,7 +14,7 @@ import argparse
 import os
 import sys
 
-from d4pg_tpu.lint.engine import lint_paths
+from d4pg_tpu.lint.engine import build_lock_graph, lint_paths
 from d4pg_tpu.lint.rules import RULES
 
 
@@ -27,12 +31,27 @@ def main(argv: list[str] | None = None) -> int:
                         help="print the rule catalog and exit")
     parser.add_argument("--show-suppressed", action="store_true",
                         help="also print suppressed findings")
+    parser.add_argument("--locks", action="store_true",
+                        help="print the whole-program lock graph (nodes, "
+                             "edges, cycles) instead of findings; exit 1 "
+                             "iff a cycle exists")
     args = parser.parse_args(argv)
 
     if args.list_rules:
         for rule in RULES.values():
-            print(f"{rule.id:20s} {rule.summary}")
+            print(f"{rule.id:22s} {rule.summary}")
         return 0
+
+    if args.locks:
+        from d4pg_tpu.lint.lockgraph import format_graph
+
+        paths = args.paths or [os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))]
+        graph, errors = build_lock_graph(paths)
+        print(format_graph(graph))
+        for e in errors:
+            print(e, file=sys.stderr)
+        return 1 if graph.cycles else 0
 
     rules = None
     if args.rules:

@@ -113,6 +113,27 @@ class ModuleContext:
         return func in self.traced
 
 
+def iter_defs(tree: ast.Module):
+    """Yield ``(node, qualname, class_name)`` for every function/method in
+    a module — the def index the interprocedural lock-graph pass
+    (``lint/lockgraph.py``) resolves call sites against, mirroring how
+    the traced-fn propagation above indexes same-module defs. Lambdas
+    are skipped (they cannot be called by name across functions);
+    ``qualname`` is dotted through enclosing classes and functions."""
+    def walk(node, prefix: str, cls: str | None):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                yield child, qual, cls
+                yield from walk(child, qual + ".", cls)
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.", child.name)
+            elif not isinstance(child, ast.Lambda):
+                yield from walk(child, prefix, cls)
+
+    yield from walk(tree, "", None)
+
+
 def _int_tuple(node: ast.expr | None) -> tuple[int, ...]:
     """Literal int / tuple-or-list of ints -> tuple; anything else -> ()."""
     if node is None:

@@ -1,11 +1,19 @@
-"""jaxlint driver: file discovery, rule execution, suppression filtering."""
+"""jaxlint driver: file discovery, rule execution, suppression filtering.
+
+Two rule scopes (``rules.Rule.scope``): *module* rules run per file, the
+*program* families (the interprocedural lock graph — ``lock-cycle``,
+``unguarded-shared-write``) run ONCE over every parsed module of the
+invocation so cross-module call edges (``replay_service`` into
+``staging``) exist. ``lint_source`` treats its single module as a whole
+program, which is what the fixture tests drive.
+"""
 
 from __future__ import annotations
 
 import os
 from dataclasses import dataclass, field
 
-from d4pg_tpu.lint.context import build_context
+from d4pg_tpu.lint.context import ModuleContext, build_context
 from d4pg_tpu.lint.findings import Finding, Suppressions
 from d4pg_tpu.lint.rules import RULES
 
@@ -36,9 +44,41 @@ def iter_py_files(paths: list[str]):
                         yield os.path.join(root, f)
 
 
+def _split_rules(rules: list[str] | None) -> tuple[list, list[str]]:
+    active = [RULES[r] for r in rules] if rules else list(RULES.values())
+    module_rules = [r for r in active if r.scope == "module"]
+    program_ids = [r.id for r in active if r.scope == "program"]
+    return module_rules, program_ids
+
+
+def _sift(collected: list[Finding], sup: Suppressions,
+          result: LintResult) -> None:
+    for f in sorted(collected, key=lambda f: (f.line, f.col, f.rule)):
+        if sup.covers(f):
+            f.suppressed = True
+            result.suppressed.append(f)
+        else:
+            result.findings.append(f)
+
+
+def _run_program_rules(ctxs: list[ModuleContext], program_ids: list[str],
+                       sups: dict[str, Suppressions],
+                       result: LintResult) -> None:
+    if not program_ids or not ctxs:
+        return
+    from d4pg_tpu.lint import lockgraph
+
+    per_file: dict[str, list[Finding]] = {}
+    for f in lockgraph.analyze(ctxs, rules=program_ids).findings:
+        per_file.setdefault(f.file, []).append(f)
+    for path, found in sorted(per_file.items()):
+        _sift(found, sups.get(path, Suppressions()), result)
+
+
 def lint_source(source: str, path: str = "<string>",
                 rules: list[str] | None = None) -> LintResult:
-    """Lint one source string; the unit the fixture tests drive."""
+    """Lint one source string; the unit the fixture tests drive. The
+    program families see a one-module program."""
     result = LintResult()
     try:
         ctx = build_context(path, source)
@@ -46,22 +86,21 @@ def lint_source(source: str, path: str = "<string>",
         result.errors.append(f"{path}: syntax error: {e}")
         return result
     sup = Suppressions.parse(source)
-    active = [RULES[r] for r in rules] if rules else list(RULES.values())
+    module_rules, program_ids = _split_rules(rules)
     collected: list[Finding] = []
-    for rule in active:
+    for rule in module_rules:
         collected.extend(rule.check(ctx))
-    for f in sorted(collected, key=lambda f: (f.line, f.col, f.rule)):
-        if sup.covers(f):
-            f.suppressed = True
-            result.suppressed.append(f)
-        else:
-            result.findings.append(f)
+    _sift(collected, sup, result)
+    _run_program_rules([ctx], program_ids, {path: sup}, result)
     return result
 
 
 def lint_paths(paths: list[str],
                rules: list[str] | None = None) -> LintResult:
     result = LintResult()
+    module_rules, program_ids = _split_rules(rules)
+    ctxs: list[ModuleContext] = []
+    sups: dict[str, Suppressions] = {}
     for path in iter_py_files(paths):
         try:
             with open(path, encoding="utf-8") as f:
@@ -69,8 +108,34 @@ def lint_paths(paths: list[str],
         except OSError as e:
             result.errors.append(f"{path}: {e}")
             continue
-        one = lint_source(source, path, rules=rules)
-        result.findings.extend(one.findings)
-        result.suppressed.extend(one.suppressed)
-        result.errors.extend(one.errors)
+        try:
+            ctx = build_context(path, source)
+        except SyntaxError as e:
+            result.errors.append(f"{path}: syntax error: {e}")
+            continue
+        ctxs.append(ctx)
+        sups[path] = Suppressions.parse(source)
+        collected: list[Finding] = []
+        for rule in module_rules:
+            collected.extend(rule.check(ctx))
+        _sift(collected, sups[path], result)
+    _run_program_rules(ctxs, program_ids, sups, result)
     return result
+
+
+def build_lock_graph(paths: list[str]):
+    """The ``--locks`` review artifact: the whole-program lock graph over
+    ``paths`` (nodes, edges with witnesses, cycles)."""
+    from d4pg_tpu.lint import lockgraph
+
+    ctxs: list[ModuleContext] = []
+    errors: list[str] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            ctxs.append(build_context(path, source))
+        except (OSError, SyntaxError) as e:
+            errors.append(f"{path}: {e}")
+    graph = lockgraph.analyze(ctxs)
+    return graph, errors

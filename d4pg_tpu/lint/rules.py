@@ -1,6 +1,6 @@
 """The jaxlint rule catalog.
 
-Seven rule families, each targeting a hazard that silently costs
+Nine rule families, each targeting a hazard that silently costs
 throughput or correctness on this stack (see docs/architecture.md "Static
 analysis & perf sentinels" for the rationale and suppression policy):
 
@@ -11,6 +11,12 @@ analysis & perf sentinels" for the rationale and suppression policy):
 - ``tracer-leak``          — mutating outer state from inside a trace
 - ``device-put-in-loop``   — per-item H2D transfers in a Python loop
 - ``lock-order``           — service/buffer lock acquired under a shard lock
+- ``lock-cycle``           — interprocedural ABBA cycle in the lock graph
+- ``unguarded-shared-write`` — shared attribute mutated off its owning lock
+
+The last two are PROGRAM-scope families implemented in
+``lint/lockgraph.py``: they analyze every module of a lint run together
+(cross-module call graph), where everything above is per-module.
 
 Every rule is a function ``(ModuleContext) -> list[Finding]`` registered in
 ``RULES``. Rules are deliberately conservative: a finding should be either
@@ -677,6 +683,22 @@ class Rule:
     id: str
     summary: str
     check: object  # (ModuleContext) -> list[Finding]
+    # 'module' rules see one file at a time; 'program' rules (the lock
+    # graph) run ONCE over every analyzed module together — the engine
+    # dispatches them to lint/lockgraph.py instead of the per-file loop.
+    scope: str = "module"
+
+
+def _program_rule(rule_id: str):
+    """Single-module fallback so ``lint_source`` (fixtures, snippets)
+    drives the program families through the same registry entry; whole
+    trees go through ``engine.lint_paths``'s one-shot program pass."""
+    def check(ctx: ModuleContext) -> list[Finding]:
+        from d4pg_tpu.lint import lockgraph
+
+        return lockgraph.analyze([ctx], rules=[rule_id]).findings
+
+    return check
 
 
 RULES: dict[str, Rule] = {r.id: r for r in [
@@ -708,4 +730,12 @@ RULES: dict[str, Rule] = {r.id: r for r in [
          "buffer/service lock acquired while holding a shard/ring leaf "
          "lock — the sharded-ingest deadlock shape",
          rule_lock_order),
+    Rule("lock-cycle",
+         "cycle in the interprocedural held-while-acquiring lock graph "
+         "(ABBA across any number of calls) — see lint/lockgraph.py",
+         _program_rule("lock-cycle"), scope="program"),
+    Rule("unguarded-shared-write",
+         "attribute written without the lock every other access holds "
+         "(ownership inferred; declare `# jaxlint: guarded-by=<lock>`)",
+         _program_rule("unguarded-shared-write"), scope="program"),
 ]}

@@ -250,7 +250,13 @@ class FusedDeviceReplay:
     def storage(self) -> TransitionBatch:
         return self._store.arrays
 
-    def stage_block(self) -> int:
+    # Every shipped caller of the three mutating learner-side entry
+    # points below reaches them through ReplayService.ingest_stage/
+    # ingest_commit/drain_device/load_replay_state, i.e. UNDER the
+    # service's buffer lock; the guarded-by annotations declare that
+    # caller contract to the unguarded-shared-write lock-graph rule
+    # (bench.py drives the buffer directly, single-threaded).
+    def stage_block(self) -> int:  # jaxlint: guarded-by=_buffer_lock
         """Start the H2D transfer of ONE pending block frame (a single
         ``jax.device_put`` of the fixed-shape [block_rows] views) — the
         only explicit transfer the ingest plane makes. No-op while a frame
@@ -269,7 +275,7 @@ class FusedDeviceReplay:
         self._inflight = (frame, n)
         return n
 
-    def commit_staged(self) -> int:
+    def commit_staged(self) -> int:  # jaxlint: guarded-by=_buffer_lock
         """Land the in-flight frame: ONE jitted dispatch fusing the
         two-slice ring write with the PER tree insert (storage and trees
         donated). Learner thread only. Returns rows committed."""
@@ -346,7 +352,9 @@ class FusedDeviceReplay:
             d["max_priority"] = float(self.trees.max_priority)
         return d
 
-    def load_state_dict(self, d: dict) -> None:
+    # restore mutates ring+tree state: reached via ReplayService.
+    # load_replay_state under the buffer lock, like the paths above
+    def load_state_dict(self, d: dict) -> None:  # jaxlint: guarded-by=_buffer_lock
         import jax.numpy as jnp
 
         from d4pg_tpu.replay.uniform import unpack_rows

@@ -16,11 +16,12 @@ single-``device_put`` + single-jitted-commit fused dispatch.
 from __future__ import annotations
 
 import itertools
-import threading
 from collections import deque
 from typing import Callable, Iterator
 
 import jax
+
+from d4pg_tpu.core.locking import TieredLock
 
 
 class MultiRingStaging:
@@ -33,9 +34,11 @@ class MultiRingStaging:
     per-row bitwise oracle survive sharding untouched.
 
     Ownership: shard ``i``'s worker is the only pusher of ring ``i``;
-    each ring (and its record deque) is guarded by one leaf lock, held
-    only for the slice-copy — never while taking any service or buffer
-    lock (the ``lock-order`` jaxlint rule enforces the direction).
+    each ring (and its record deque) is guarded by one leaf lock
+    (``core.locking.TieredLock`` at the bottom ``ring`` tier), held only
+    for the slice-copy — never while taking any service or buffer lock.
+    The direction is enforced by the ``lock-order``/``lock-cycle``
+    jaxlint rules statically and by the tier assertions at runtime.
 
     Merge-commit ordering rule: every pushed batch carries a monotonic
     admission ticket (per-ring ascending; globally unique). ``frame()``
@@ -55,7 +58,7 @@ class MultiRingStaging:
         self.block_rows = int(block_rows)
         self._rings = [HostStagingRing(specs, block_rows, n_blocks)
                        for _ in range(self.shards)]
-        self._ring_locks = [threading.Lock() for _ in range(self.shards)]
+        self._ring_locks = [TieredLock("ring") for _ in range(self.shards)]
         # per-ring (ticket, rows) records, ticket-ascending
         self._records: list[deque] = [deque() for _ in range(self.shards)]
         self._merge = HostStagingRing(specs, block_rows, 2)

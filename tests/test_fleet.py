@@ -119,6 +119,15 @@ def test_fleet_smoke_n8_with_chaos():
     assert result["receiver_stalls"] > 0
     lat = result["send_latency_ms"]
     assert lat["n"] > 0 and lat["p99"] >= lat["p50"] > 0
+    # the smoke runs with lock-hierarchy assertions armed (record mode):
+    # zero violations, and per-lock contention counters in the artifact
+    locks = result["locks"]
+    assert locks["hierarchy_violations"] == 0
+    assert locks["violation_samples"] == []
+    for tier in ("service", "shard", "commit"):
+        per = locks["per_lock"][tier]
+        assert per["acquisitions"] > 0
+        assert per["wait_ns"] >= 0 and per["max_hold_ns"] > 0
 
 
 def test_fleet_seeded_run_reproducible_bitwise():
@@ -196,6 +205,10 @@ def test_fleet_smoke_sharded_k2():
     assert result["ticks"] == 8 * 12
     assert result["rows_per_sec_per_shard"] == pytest.approx(
         result["rows_per_sec"] / 2, abs=0.1)
+    # K=2 exercises the full tier stack under chaos — still zero
+    # hierarchy violations, and the shard conditions saw real traffic
+    assert result["locks"]["hierarchy_violations"] == 0
+    assert result["locks"]["per_lock"]["shard"]["acquisitions"] > 0
     shards = result["per_shard"]
     assert [s["shard"] for s in shards] == [0, 1]
     # per-shard admission accounting covers every delivered row
@@ -295,9 +308,13 @@ def test_shard_sweep_slow():
     for row in artifact["sweep"]:
         assert row["deadlocks"] == 0
         assert row["rows_per_sec"] > 0
+        assert row["locks"]["hierarchy_violations"] == 0
     scaling = artifact["scaling"]
     assert scaling[0]["speedup_vs_k1"] == 1.0
     assert all(s["vs_ceiling"] is not None for s in scaling)
+    # the K-sweep's lock-wait attribution column is populated per K
+    assert all(s["lock_wait_ms"] is not None
+               and s["hierarchy_violations"] == 0 for s in scaling)
 
 
 @pytest.mark.slow

@@ -302,8 +302,12 @@ def test_rule_catalog_covers_all_families():
     assert set(RULES) == {
         "prng-key-reuse", "host-sync-in-jit", "recompile-hazard",
         "use-after-donation", "tracer-leak", "device-put-in-loop",
-        "lock-order",
+        "lock-order", "lock-cycle", "unguarded-shared-write",
     }
+    # the lock-graph families analyze whole programs, not single modules
+    assert RULES["lock-cycle"].scope == "program"
+    assert RULES["unguarded-shared-write"].scope == "program"
+    assert RULES["lock-order"].scope == "module"
 
 
 # ---------------------------------------------------------------- R7 ------
@@ -403,3 +407,257 @@ def test_device_put_in_loop_clean_patterns():
 def test_syntax_error_reported_not_raised(tmp_path):
     res = lint_source("def broken(:\n", "broken.py")
     assert res.errors and not res.clean
+
+
+# ------------------------------------------------- R8: lock-cycle ---------
+
+def test_lock_cycle_fires_on_cross_function_abba():
+    """The shape the syntactic lock-order rule CANNOT see: each function
+    nests correctly in isolation; the ABBA cycle only exists through the
+    call edges (worker holds the shard cond into a helper that takes the
+    merge cond; the committer holds the merge cond into a helper that
+    takes the shard cond)."""
+    out = findings("""
+        class Service:
+            def worker(self, shard):
+                with shard.cond:
+                    self._hand_off(shard)
+
+            def _hand_off(self, shard):
+                with self._commit_cond:
+                    self._commit_cond.notify_all()
+
+            def committer(self, shard):
+                with self._commit_cond:
+                    self._drain_one(shard)
+
+            def _drain_one(self, shard):
+                with shard.cond:
+                    return shard.q.popleft()
+        """, "lock-cycle")
+    assert len(out) == 1
+    assert "cond" in out[0].message and "_commit_cond" in out[0].message
+    assert "deadlock" in out[0].message
+
+
+def test_lock_cycle_fires_on_direct_abba():
+    out = findings("""
+        class S:
+            def a(self):
+                with self._ring_locks[0]:
+                    with self._buffer_lock:
+                        pass
+
+            def b(self):
+                with self._buffer_lock:
+                    with self._ring_locks[1]:
+                        pass
+        """, "lock-cycle")
+    assert len(out) == 1
+
+
+def test_lock_cycle_clean_on_consistent_order():
+    """Hierarchy-consistent nesting — even deep through calls — must not
+    fire: every path acquires in one global order."""
+    out = findings("""
+        class Service:
+            def committer(self, shard):
+                with self._buffer_lock:
+                    self._insert(shard)
+
+            def _insert(self, shard):
+                with shard.ring_lock:
+                    shard.rows.clear()
+
+            def sampler(self):
+                with self._buffer_lock:
+                    with self._ring_locks[0]:
+                        return 1
+
+            def sequential(self, shard):
+                with shard.cond:
+                    shard.q.clear()
+                with self._buffer_lock:
+                    return 2
+        """, "lock-cycle")
+    assert out == []
+
+
+def test_lock_cycle_merge_wedge_regression():
+    """Acceptance bar: re-introducing the PR-4 merge-wedge DISCIPLINE
+    REVERT — the shard worker waiting on merge-inbox state while still
+    holding its shard condition — is caught statically even though the
+    commit-cond acquisition is a call away (the runtime twin of this
+    regression lives in test_locking.py::test_merge_wedge_shape_is_caught
+    on the real service objects)."""
+    out = findings("""
+        class ReplayService:
+            def _worker(self, s):
+                with s.cond:
+                    items = self._pop_coalesced(s)
+                    self._wait_for_inbox(s)   # REVERTED: was outside s.cond
+                    return items
+
+            def _wait_for_inbox(self, s):
+                with self._commit_cond:
+                    while self._out[s.idx]:
+                        self._commit_cond.wait(0.1)
+
+            def _commit_loop(self):
+                with self._commit_cond:
+                    group = self._pop_ready()
+                for s in self._shards:
+                    self._settle(s)
+
+            def _pop_ready(self):
+                return list(self._out)
+
+            def _settle(self, s):
+                with s.cond:
+                    s.cond.notify_all()
+        """)
+    cyc = [f for f in out if f.rule == "lock-cycle"]
+    assert len(cyc) == 1
+    assert "cond" in cyc[0].message and "_commit_cond" in cyc[0].message
+
+
+# ------------------------------------- R9: unguarded-shared-write ---------
+
+def test_unguarded_write_fires_on_naked_counter():
+    """A genuine unguarded counter: every other access takes the lock;
+    the hot-path increment skips it."""
+    out = findings("""
+        import threading
+
+        class Stats:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.rows = 0
+
+            def bump(self, n):
+                with self._lock:
+                    self.rows += n
+
+            def snapshot(self):
+                with self._lock:
+                    return {"rows": self.rows}
+
+            def fast_path(self, n):
+                self.rows += n   # racy read-modify-write
+        """, "unguarded-shared-write")
+    assert len(out) == 1
+    assert "'rows'" in out[0].message and "'_lock'" in out[0].message
+    assert "guarded-by" in out[0].message
+
+
+def test_unguarded_write_satisfied_by_annotation():
+    """`# jaxlint: guarded-by=<lock>` declares the caller-holds-it
+    contract (line-level or def-level) and satisfies the checker."""
+    out = findings("""
+        import threading
+
+        class Stats:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.rows = 0
+
+            def bump(self, n):
+                with self._lock:
+                    self.rows += n
+
+            def snapshot(self):
+                with self._lock:
+                    return {"rows": self.rows}
+
+            def _bump_locked(self, n):  # jaxlint: guarded-by=_lock
+                self.rows += n
+
+            def line_level(self, n):
+                self.rows += n  # jaxlint: guarded-by=_lock
+        """, "unguarded-shared-write")
+    assert out == []
+
+
+def test_unguarded_write_inherits_caller_lock():
+    """A helper whose EVERY call site holds the lock is guarded by
+    inheritance — no annotation needed (the _pop_ready pattern: writes
+    under the commit condition held by the caller)."""
+    out = findings("""
+        import threading
+
+        class Merge:
+            def __init__(self):
+                self._commit_cond = threading.Condition()
+                self.order_breaks = 0
+
+            def loop(self):
+                with self._commit_cond:
+                    self._pop_ready()
+
+            def valve(self):
+                with self._commit_cond:
+                    self._pop_ready()
+                    self.order_breaks += 1
+
+            def _pop_ready(self):
+                self.order_breaks += 1
+        """, "unguarded-shared-write")
+    assert out == []
+
+
+def test_unguarded_write_silent_without_majority():
+    """Single-writer attributes read without the lock everywhere are NOT
+    lock-owned — inference must stay silent rather than guess."""
+    out = findings("""
+        import threading
+
+        class Ring:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.head = 0
+
+            def write(self):
+                with self._lock:
+                    self.head += 1
+
+            def reader_a(self):
+                return self.head
+
+            def reader_b(self):
+                return self.head + 1
+        """, "unguarded-shared-write")
+    assert out == []
+
+
+def test_lock_graph_cli_mode(tmp_path, capsys):
+    """`--locks` prints the graph artifact; exit 1 iff a cycle exists."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        class S:
+            def a(self):
+                with self._ring_locks[0]:
+                    with self._buffer_lock:
+                        pass
+
+            def b(self):
+                with self._buffer_lock:
+                    with self._ring_locks[1]:
+                        pass
+        """))
+    assert lint_main(["--locks", str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "_buffer_lock" in out and "_ring_locks" in out
+    assert "cycles:" in out and "edges" in out
+
+    good = tmp_path / "good.py"
+    good.write_text(textwrap.dedent("""
+        class S:
+            def a(self):
+                with self._buffer_lock:
+                    with self._ring_locks[0]:
+                        pass
+        """))
+    assert lint_main(["--locks", str(good)]) == 0
+    out = capsys.readouterr().out
+    assert "cycles: none" in out
+    assert "_buffer_lock -> _ring_locks" in out

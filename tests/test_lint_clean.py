@@ -43,17 +43,24 @@ def test_bench_and_entrypoints_lint_clean():
 
 @pytest.mark.lint
 def test_suppression_audit():
-    """Audit every ``# jaxlint: disable`` in the package + bench.py: each
-    must name only REGISTERED rules (a typo'd rule id suppresses nothing
-    and rots silently) and carry a justification comment on the flagged
-    line's neighborhood (the documented suppression contract — see
-    docs/architecture.md "Suppressions"). New packages (e.g. fleet/) ride
-    the same audit automatically."""
+    """Audit every ``# jaxlint: disable`` AND ``# jaxlint: guarded-by``
+    in the package + bench.py: a disable must name only REGISTERED rules
+    (a typo'd rule id suppresses nothing and rots silently), a
+    guarded-by must name a lock the whole-program lock graph actually
+    knows (a typo'd lock name vouches for nothing), and both must carry
+    a justification comment on the flagged line's neighborhood (the
+    documented contract — see docs/architecture.md "Suppressions"). New
+    packages (e.g. fleet/) ride the same audit automatically."""
     import re
 
+    from d4pg_tpu.lint.engine import build_lock_graph
+    from d4pg_tpu.lint.lockgraph import _DEFAULT_TIERS
     from d4pg_tpu.lint.rules import RULES
 
     directive = re.compile(r"#\s*jaxlint:\s*disable(?:-file)?=([\w,\- ]+)")
+    guarded = re.compile(r"#\s*jaxlint:\s*guarded-by=([\w,\- ]+)")
+    graph, _errors = build_lock_graph([PACKAGE_DIR])
+    known_locks = set(graph.nodes) | set(_DEFAULT_TIERS)
     audited = 0
     problems = []
     files = [os.path.join(REPO_ROOT, "bench.py")]
@@ -65,27 +72,86 @@ def test_suppression_audit():
             lines = f.readlines()
         for i, line in enumerate(lines):
             m = directive.search(line)
-            # the lint package's own docs/fixtures mention the directive
-            # in strings — only audit real trailing-comment suppressions
-            if m is None or os.sep + "lint" + os.sep in path:
+            g = guarded.search(line)
+            # the lint package's own docs/fixtures mention the directives
+            # in strings — only audit real trailing-comment annotations
+            if (m is None and g is None) or os.sep + "lint" + os.sep in path:
                 continue
             audited += 1
             where = f"{os.path.relpath(path, REPO_ROOT)}:{i + 1}"
-            for rule in m.group(1).replace(" ", "").split(","):
-                if rule not in RULES:
-                    problems.append(f"{where}: unknown rule {rule!r}")
-            lo, hi = max(0, i - 3), min(len(lines), i + 2)
+            if m is not None:
+                for rule in m.group(1).replace(" ", "").split(","):
+                    if rule not in RULES:
+                        problems.append(f"{where}: unknown rule {rule!r}")
+            if g is not None:
+                for lock in g.group(1).replace(" ", "").split(","):
+                    if lock not in known_locks:
+                        problems.append(
+                            f"{where}: guarded-by names unknown lock "
+                            f"{lock!r} (not in the discovered lock graph)")
+            lo, hi = max(0, i - 6), min(len(lines), i + 2)
             neighborhood = "".join(lines[lo:hi])
             # justification = at least one comment line near the
-            # suppression that is NOT itself a directive
+            # annotation that is NOT itself a directive
             has_comment = any(
                 "#" in nl and not directive.search(nl)
+                and not guarded.search(nl)
                 for nl in lines[lo:hi]) or '"""' in neighborhood
             if not has_comment:
-                problems.append(f"{where}: suppression without an adjacent "
+                problems.append(f"{where}: annotation without an adjacent "
                                 "justification comment")
     assert audited > 0, "audit found no suppressions — regex rot?"
     assert not problems, "\n".join(problems)
+
+
+@pytest.mark.lint
+def test_lock_graph_clean_over_package():
+    """Tier-1 gate for the concurrency plane: the whole-program lock
+    graph over ``d4pg_tpu/`` must contain the declared ingest-plane
+    locks, carry NO cycles, and only hierarchy-descending tiered edges
+    (``test_package_lints_clean`` already fails on ``lock-cycle``/
+    ``unguarded-shared-write`` findings; this pins the graph shape the
+    ``--locks`` review artifact prints)."""
+    from d4pg_tpu.core.locking import HIERARCHY
+    from d4pg_tpu.lint.engine import build_lock_graph
+    from d4pg_tpu.lint.lockgraph import _DEFAULT_TIERS, format_graph
+
+    graph, errors = build_lock_graph([PACKAGE_DIR])
+    assert not errors, errors
+    assert graph.cycles == [], format_graph(graph)
+    # the ingest plane's locks are all discovered, with their tier labels
+    for lock, tier in (("_lock", "service"), ("_buffer_lock", "buffer"),
+                       ("_commit_cond", "commit"), ("cond", "shard"),
+                       ("_ring_locks", "ring")):
+        assert lock in graph.nodes, sorted(graph.nodes)
+        assert graph.nodes[lock] == tier
+    # every edge between tier-labeled locks DESCENDS the hierarchy
+    tiers = dict(_DEFAULT_TIERS)
+    tiers.update({k: v for k, v in graph.nodes.items() if v})
+    for (held, acquired) in graph.edges:
+        th = HIERARCHY.get(tiers.get(held, ""))
+        tb = HIERARCHY.get(tiers.get(acquired, ""))
+        if th is not None and tb is not None and held != acquired:
+            # name-identity merges unrelated same-named locks (e.g. the
+            # sender-side transport._lock with the service lock), so
+            # only leaf-held ascents are hard failures — mirroring the
+            # lock-cycle rule's leaf-ascent check
+            assert not (th <= HIERARCHY["shard"] and tb >= th), (
+                f"leaf ascent {held} -> {acquired}: "
+                + str(graph.edges[(held, acquired)]))
+
+
+@pytest.mark.lint
+def test_cli_locks_mode_clean():
+    """``python -m d4pg_tpu.lint --locks`` is the review artifact for
+    concurrency PRs; it must exit 0 (no cycles) on the repo and print
+    the graph."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "d4pg_tpu.lint", "--locks", PACKAGE_DIR],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "cycles: none" in proc.stdout
+    assert "_commit_cond" in proc.stdout
 
 
 @pytest.mark.lint

@@ -1,0 +1,199 @@
+"""Runtime lock-hierarchy sentinels (``core/locking.py``).
+
+The declared tier table (service > buffer > commit > shard > ring) is
+the runtime half of the concurrency plane: in debug mode every
+acquisition asserts monotone tier descent per thread and counts
+contention. These tests pin the enforcement semantics — including the
+regression the plane exists for: re-introducing the PR-4 merge-wedge
+shape (commit-cond work under a shard leaf lock) must be DETECTED, not
+silently deadlock-prone.
+"""
+
+import threading
+
+import pytest
+
+from d4pg_tpu.core import locking
+from d4pg_tpu.core.locking import (
+    HIERARCHY, LockHierarchyError, TieredCondition, TieredLock,
+)
+
+pytestmark = pytest.mark.concurrency
+
+
+@pytest.fixture
+def debug_mode():
+    locking.enable_debug(raise_on_violation=True)
+    locking.reset_stats()
+    yield
+    locking.disable_debug()
+    locking.reset_stats()
+
+
+def test_hierarchy_table_shape():
+    # outermost first, strictly decreasing, the five declared tiers
+    assert list(HIERARCHY) == ["service", "buffer", "commit", "shard",
+                               "ring"]
+    tiers = list(HIERARCHY.values())
+    assert tiers == sorted(tiers, reverse=True)
+    assert len(set(tiers)) == len(tiers)
+
+
+def test_static_pass_mirrors_runtime_hierarchy():
+    """The lint package is stdlib-only (no jax via d4pg_tpu.core), so
+    lockgraph MIRRORS the tier table instead of importing it; this pin
+    is what keeps the two declarations one source of truth."""
+    from d4pg_tpu.lint.lockgraph import _TIER_VALUES
+
+    assert _TIER_VALUES == HIERARCHY
+
+
+def test_descent_is_legal_and_tracked(debug_mode):
+    svc, buf, ring = (TieredLock("service"), TieredLock("buffer"),
+                      TieredLock("ring"))
+    with svc:
+        with buf:
+            with ring:
+                assert [n for _, n in locking.held_tiers()] == [
+                    "service", "buffer", "ring"]
+    assert locking.held_tiers() == []
+    assert locking.violation_count() == 0
+
+
+def test_sequential_same_tier_is_legal(debug_mode):
+    a, b = TieredCondition("shard"), TieredCondition("shard")
+    with a:
+        pass
+    with b:
+        pass
+    assert locking.violation_count() == 0
+
+
+def test_inverted_acquisition_raises(debug_mode):
+    """The unit acceptance bar: a deliberately inverted acquisition
+    (buffer while holding ring — ascent) raises immediately."""
+    buf, ring = TieredLock("buffer"), TieredLock("ring")
+    with ring:
+        with pytest.raises(LockHierarchyError, match="hierarchy violation"):
+            buf.acquire()
+    assert locking.violation_count() == 1
+
+
+def test_equal_tier_nesting_raises(debug_mode):
+    # two sibling shard conditions held at once: the hidden worker-vs-
+    # worker deadlock; strict descent forbids equal tiers too
+    a, b = TieredCondition("shard"), TieredCondition("shard")
+    with a:
+        with pytest.raises(LockHierarchyError):
+            b.acquire()
+
+
+def test_merge_wedge_shape_is_caught(debug_mode):
+    """THE regression: revert the PR-4 discipline locally — do
+    commit-cond work while holding a shard leaf condition (the shape
+    whose cross-thread interleaving wedged the ordered merge) — on the
+    REAL service's locks, and assert the runtime sentinel catches it."""
+    from d4pg_tpu.distributed.replay_service import ReplayService
+    from d4pg_tpu.replay.uniform import ReplayBuffer
+
+    svc = ReplayService(ReplayBuffer(128, 3, 2), num_ingest_shards=2)
+    try:
+        shard = svc._shards[0]
+        with pytest.raises(LockHierarchyError):
+            with shard.cond:           # leaf held ...
+                with svc._commit_cond:  # ... merge work under it: WEDGE
+                    pass
+        # ... and the old review bug: settling service accounting
+        # (_pending, under _lock) INSIDE the merge condition
+        with pytest.raises(LockHierarchyError):
+            with svc._commit_cond:
+                with svc._lock:
+                    pass
+        # the shipped discipline itself stays silent: commit-cond then
+        # (sequentially) the service lock, exactly as _commit_loop runs
+        with svc._commit_cond:
+            pass
+        with svc._lock:
+            pass
+    finally:
+        locking.disable_debug()  # close() joins threads that wait()
+        svc.close()
+
+
+def test_record_mode_counts_instead_of_raising():
+    locking.enable_debug(raise_on_violation=False)
+    locking.reset_stats()
+    try:
+        svc, ring = TieredLock("service"), TieredLock("ring")
+        with ring:
+            with svc:  # ascent — recorded, not raised
+                pass
+        assert locking.violation_count() == 1
+        assert "hierarchy violation" in locking.hierarchy_violations()[0]
+    finally:
+        locking.disable_debug()
+        locking.reset_stats()
+
+
+def test_condition_wait_keeps_stack_consistent(debug_mode):
+    cond = TieredCondition("commit")
+    done = []
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=0.05)
+            done.append(locking.held_tiers())
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    t.join(timeout=5.0)
+    assert done and [n for _, n in done[0]] == ["commit"]
+    assert locking.held_tiers() == []  # main thread untouched
+    stats = locking.lock_stats()
+    assert stats["commit"]["cond_waits"] == 1
+
+
+def test_contention_counters(debug_mode):
+    lock = TieredLock("service")
+    lock.acquire()
+    seen = []
+
+    def contender():
+        with lock:
+            seen.append(True)
+
+    t = threading.Thread(target=contender)
+    t.start()
+    # let the contender hit the held lock, then release
+    for _ in range(1000):
+        if lock._contended:
+            break
+        threading.Event().wait(0.001)
+    lock.release()
+    t.join(timeout=5.0)
+    assert seen
+    stats = locking.lock_stats()["service"]
+    assert stats["acquisitions"] == 2
+    assert stats["contended"] == 1
+    assert stats["wait_ns"] > 0
+    assert stats["max_hold_ns"] > 0
+
+
+def test_production_mode_is_plain_delegation():
+    assert not locking.debug_enabled()
+    lock, cond = TieredLock("buffer"), TieredCondition("shard")
+    with lock:
+        pass
+    with cond:
+        cond.notify_all()
+    # no bookkeeping happened
+    assert locking.held_tiers() == []
+    assert lock._acquisitions == 0
+
+
+def test_unknown_tier_rejected():
+    with pytest.raises(ValueError):
+        TieredLock("no-such-tier")
+    custom = TieredLock("custom", tier=99)  # explicit tier escape hatch
+    with custom:
+        pass
