@@ -41,6 +41,10 @@ STEPS = 320
 # measured by bench_reference_torch_cpu below); fallback when the live
 # measurement is unavailable.
 RECORDED_BASELINE_SPS = 39.6
+# fused-learner median from the newest committed accelerator artifact
+# (BENCH_r05); the denominator for the host-side tracing-overhead bound
+# in bench_fleet_latency (a live TPU capture would refresh it).
+RECORDED_FUSED_STEPS_PER_SEC = 152_630.0
 
 
 def _bench_config():
@@ -285,6 +289,49 @@ def bench_ingest(capacity: int = 200_000, block_rows: int = 4096,
     assert tr.h2d <= n_dispatch + 1, (
         f"{tr.h2d} explicit H2D over {n_dispatch} chunks breaks the "
         "<=1-per-chunk invariant")
+
+    # -- ingest-stage latency block (obs plane) ----------------------------
+    # per-block stage (ONE device_put) and commit (ONE jitted dispatch)
+    # latencies as histograms, plus the measured registry overhead the
+    # unified counters add per row (they inc per BLOCK, so the per-row
+    # cost is inc_ns * incs_per_block / block_rows — reported against
+    # the measured per-row ingest budget).
+    from d4pg_tpu.obs.registry import REGISTRY, percentile_summary
+
+    stage_ms, commit_ms = [], []
+    buf = fresh()
+    for _ in range(32):
+        buf.add(feed)
+        while True:
+            t0 = time.perf_counter()
+            n_staged = buf.stage_block()
+            stage_ms.append(1e3 * (time.perf_counter() - t0))
+            if not n_staged:
+                stage_ms.pop()  # empty probe, not a stage
+                break
+            t0 = time.perf_counter()
+            buf.commit_staged()
+            commit_ms.append(1e3 * (time.perf_counter() - t0))
+    jax.block_until_ready(buf.storage.obs)
+    c = REGISTRY.counter("bench.calibration")
+    t0 = time.perf_counter()
+    for _ in range(100_000):
+        c.inc()
+    inc_ns = 1e9 * (time.perf_counter() - t0) / 100_000
+    incs_per_block = 4  # staging push + fused staged/committed/blocks
+    row_budget_ns = 1e9 / solo if solo else None
+    overhead_pct = (round(100.0 * inc_ns * incs_per_block
+                          / (block_rows * row_budget_ns), 4)
+                    if row_budget_ns else None)
+    latency = {
+        "unit": "ms",
+        "stages": {
+            "stage_block": percentile_summary(stage_ms),
+            "commit_staged": percentile_summary(commit_ms),
+        },
+        "registry_inc_ns": round(inc_ns, 1),
+        "registry_overhead_pct": overhead_pct,
+    }
     return {
         "solo": round(solo, 1),
         "concurrent": round(committed / dt, 1),
@@ -294,7 +341,78 @@ def bench_ingest(capacity: int = 200_000, block_rows: int = 4096,
         "block_rows": block_rows,
         "h2d_per_chunk": round(tr.h2d / n_dispatch, 3),
         "steady_state_recompiles": rec.compilations,
+        "latency": latency,
     }
+
+
+def bench_fleet_latency(n_actors: int = 64, duration_s: float = 10.0,
+                        seed: int = 0, chaos=None,
+                        rows_per_sec: float = 60.0) -> dict:
+    """The wire-to-grad latency block (docs/architecture.md
+    "Observability plane"): a seeded N>=64 chaos run over the sharded
+    (K=2, v2 raw) plane with trace sampling at the default rate —
+    per-stage latency histograms p50/p95/p99 with end-to-end
+    wire-to-grad as the headline — plus the measured tracing overhead:
+
+      - an identical untraced twin run (same seed, same chaos script)
+        prices the rows/s loss of sampling + span recording + the
+        concurrent consumer lane against the plane's throughput,
+      - a host microbench of the per-chunk learner hook (mark_grad +
+        two registry incs) bounds the fused-steps/s loss: the hook is
+        the ONLY code tracing adds to the fused learner loop, so
+        loss <= hook_ns / (K * per-step budget at the recorded
+        BENCH_r05 rate).
+    """
+    from d4pg_tpu.fleet.chaos import ChaosConfig
+    from d4pg_tpu.fleet.harness import FleetConfig, FleetHarness
+    from d4pg_tpu.fleet.sweep import default_chaos
+    from d4pg_tpu.obs.registry import REGISTRY
+    from d4pg_tpu.obs.trace import DEFAULT_SAMPLE, RECORDER
+
+    chaos = default_chaos(seed) if chaos is None else chaos
+    if not isinstance(chaos, ChaosConfig):
+        chaos = ChaosConfig(seed=seed)
+
+    def run(sample: float) -> dict:
+        cfg = FleetConfig(n_actors=n_actors, duration_s=duration_s,
+                          rows_per_sec=rows_per_sec, ingest_shards=2,
+                          chaos=chaos, trace_sample=sample)
+        return FleetHarness(cfg).run()
+
+    traced = run(DEFAULT_SAMPLE)
+    untraced = run(0.0)
+    rps_t, rps_u = traced["rows_per_sec"], untraced["rows_per_sec"]
+    # per-chunk learner hook: mark_grad on an idle recorder + the two
+    # registry incs the fused commit path pays per block
+    RECORDER.disable()
+    c = REGISTRY.counter("bench.calibration")
+    reps = 200_000
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        RECORDER.mark_grad()
+        c.inc()
+        c.inc()
+    hook_ns = 1e9 * (time.perf_counter() - t0) / reps
+    # fused plane: K=40 steps/chunk at the recorded BENCH_r05 median —
+    # the hook runs once per chunk, so its per-step share is hook/K
+    k = 40
+    step_budget_ns = 1e9 / RECORDED_FUSED_STEPS_PER_SEC
+    fused_loss_pct = round(100.0 * (hook_ns / k) / step_budget_ns, 4)
+    block = dict(traced["latency"] or {})
+    block["overhead"] = {
+        "rows_per_sec_traced": rps_t,
+        "rows_per_sec_untraced": rps_u,
+        "rows_loss_pct": (round(100.0 * (rps_u - rps_t) / rps_u, 2)
+                          if rps_u else None),
+        "hook_ns_per_chunk": round(hook_ns, 1),
+        "fused_steps_loss_pct_bound": fused_loss_pct,
+        "sample_rate": DEFAULT_SAMPLE,
+    }
+    block["n_actors"] = n_actors
+    block["ingest_shards"] = 2
+    block["frames_traced"] = traced["frames_traced"]
+    block["seed"] = chaos.seed
+    return block
 
 
 def bench_fleet(ns=(8, 32, 64, 128, 256), duration_s: float = 10.0,
@@ -331,6 +449,12 @@ def bench_fleet(ns=(8, 32, 64, 128, 256), duration_s: float = 10.0,
         rows_per_sec=shard_rows_per_sec, chaos=cc)
     for row in artifact["shard_sweep"]["sweep"]:
         row.pop("chaos_log", None)
+    # wire-to-grad latency block: per-stage histograms from a seeded
+    # N>=64 chaos run + measured tracing overhead (tier-1 schema-checked
+    # in tests/test_obs.py so later PRs can't silently drop it)
+    artifact["latency"] = bench_fleet_latency(
+        n_actors=max(64, min(ns)), duration_s=duration_s, seed=seed,
+        chaos=cc, rows_per_sec=shard_rows_per_sec)
     return artifact
 
 

@@ -55,6 +55,7 @@ def run_actor(
     send_retries: int | None = None,
     drop_on_timeout: bool = False,
     codec: str = "npz",
+    trace_sample: float = 0.0,
 ) -> int:
     cfg = cfg.resolve()
     obs_dim, act_dim, obs_dtype = infer_dims(cfg)
@@ -70,12 +71,16 @@ def run_actor(
     # --codec raw: the sharded receiver's native v2 frames — ~25x cheaper
     # to encode+decode than npz and admissible (routed/shed/counted) from
     # the fixed header alone; npz (default) interops with any receiver.
+    # --trace_sample: fraction of raw frames stamped with a trace id +
+    # birth timestamp (the wire-to-grad tracing plane, d4pg_tpu/obs);
+    # inert at codec='npz' — only v2 headers carry the extension.
     sender = CoalescingSender(learner_host, transitions_port,
                               actor_id=actor_id, secret=secret,
                               retry_timeout=send_timeout,
                               max_retries=send_retries,
                               drop_on_timeout=drop_on_timeout,
-                              codec=codec)
+                              codec=codec,
+                              trace_sample=trace_sample)
     weights = WeightClient(learner_host, weights_port, secret=secret)
     actor_cfg = ActorConfig(
         epsilon_0=cfg.epsilon_0, min_epsilon=cfg.min_epsilon,
@@ -200,6 +205,11 @@ def main(argv=None):
                    help="wire frame format: npz (legacy, self-describing) "
                         "or raw (v2 column frames — the sharded receiver's "
                         "native format, ~25x cheaper per frame)")
+    p.add_argument("--trace_sample", type=float, default=0.0,
+                   help="fraction of frames stamped with a wire-to-grad "
+                        "trace id + birth timestamp in the v2 header "
+                        "extension (requires --codec raw; the learner "
+                        "aggregates per-stage latency histograms)")
     ns = p.parse_args(argv)
     if ns.actor_device == "cpu":
         # Acting runs on host CPU; force the platform BEFORE any jax call
@@ -219,7 +229,7 @@ def main(argv=None):
                       send_timeout=ns.send_timeout,
                       send_retries=ns.send_retries,
                       drop_on_timeout=bool(ns.drop_on_timeout),
-                      codec=ns.codec)
+                      codec=ns.codec, trace_sample=ns.trace_sample)
     print(f"collected {steps} env steps")
 
 

@@ -166,6 +166,12 @@ class ExperimentConfig:
     # receiver"): K SO_REUSEPORT listeners + K decode/stage workers + one
     # ordered merge-commit thread. 1 = the legacy single-drain plane.
     ingest_shards: int = 1
+    # Wire-to-grad tracing (docs/architecture.md "Observability plane"):
+    # arms the learner-side trace recorder and stamps grad-consumption
+    # spans after each fused dispatch; remote actors sample frames at
+    # this rate when launched with ``--codec raw --trace_sample <f>``.
+    # 0 = fully inert (no recorder, no per-chunk hook).
+    trace_sample: float = 0.0
     profile_dir: str = ""  # capture an XLA trace of the first cycle
     # io
     log_dir: str = "runs"  # --log_dir
@@ -392,6 +398,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="receiver-side ingest shards: K SO_REUSEPORT "
                         "listeners + K decode/stage workers + one ordered "
                         "merge-commit thread (1 = legacy single drain)")
+    p.add_argument("--trace_sample", type=float, default=d.trace_sample,
+                   help="arm wire-to-grad trace spans (obs/trace): the "
+                        "learner records per-stage latency histograms for "
+                        "frames remote actors sample at this rate over "
+                        "the raw codec (0 = off)")
     p.add_argument("--profile_dir", default=d.profile_dir)
     p.add_argument("--log_dir", default=d.log_dir)
     p.add_argument("--seed", type=int, default=d.seed)

@@ -44,6 +44,9 @@ from __future__ import annotations
 import threading
 import time
 
+from d4pg_tpu.obs.flight import record_event
+from d4pg_tpu.obs.registry import REGISTRY as _obs_registry
+
 # The declared hierarchy — the single source of truth shared with the
 # static pass and the architecture doc. Outermost (largest tier) first.
 HIERARCHY: dict[str, int] = {
@@ -148,6 +151,11 @@ def _record_violation(msg: str) -> None:
         _violation_count += 1
         if len(_violations) < _MAX_VIOLATION_RECORDS:
             _violations.append(msg)
+    # Flight-recorder event (obs/flight), recorded OUTSIDE the registry
+    # lock: a hierarchy violation is exactly the event whose surrounding
+    # context the postmortem ring exists to preserve — the fleet harness
+    # dumps the ring whenever this count is nonzero at run end.
+    record_event("lock_violation", msg=msg)
     if _raise_on_violation:
         raise LockHierarchyError(msg)
 
@@ -338,3 +346,20 @@ class TieredCondition(_TieredBase):
 
     def notify_all(self) -> None:
         self._inner.notify_all()
+
+
+def _locks_snapshot() -> dict:
+    """The unified-registry view of the lock plane: per-tier contention
+    counters + the hierarchy-violation tally. Same consistency contract
+    as the bespoke accessors it wraps (counters are owner-thread-mutated
+    and aggregated at snapshot time; see ``lock_stats``)."""
+    return {
+        "debug": _debug,
+        "hierarchy_violations": violation_count(),
+        "per_lock": lock_stats(),
+    }
+
+
+# module-level function: strong registration is fine (the lock plane
+# lives for the process, like the registry itself)
+_obs_registry.register_provider("locks", _locks_snapshot)

@@ -53,7 +53,10 @@ from typing import Optional
 import numpy as np
 
 from d4pg_tpu.core.locking import TieredCondition, TieredLock
-from d4pg_tpu.distributed.transport import decode_frame, raw_frame_meta
+from d4pg_tpu.distributed.transport import decode_frame, raw_frame_meta_ex
+from d4pg_tpu.obs.flight import record_event
+from d4pg_tpu.obs.registry import REGISTRY
+from d4pg_tpu.obs.trace import RECORDER as _tracer
 from d4pg_tpu.replay.prioritized import PrioritizedReplayBuffer
 from d4pg_tpu.replay.uniform import ReplayBuffer, TransitionBatch
 
@@ -81,9 +84,11 @@ class _IngestShard:
         self.capacity = capacity
         self.shed_at = shed_at
         self.cond = TieredCondition("shard")
-        # items: (seq, data, codec, actor_id, rows, count); codec None
-        # means ``data`` is an already-decoded TransitionBatch, else it is
-        # the undecoded wire payload for ``decode_frame(data, codec)``
+        # items: (seq, data, codec, actor_id, rows, count, trace); codec
+        # None means ``data`` is an already-decoded TransitionBatch, else
+        # it is the undecoded wire payload for ``decode_frame(data,
+        # codec)``. ``trace`` is the sampled frame's trace id (or None)
+        # riding the item so every later stage can stamp its span.
         self.q: deque = deque()
         self.sheds = 0
         self.shed_rows = 0
@@ -144,6 +149,10 @@ class ReplayService:
                 f"buffer.ingest_shards={buf_shards} must be 1 or match "
                 f"num_ingest_shards={self.num_ingest_shards}")
         self._env_steps = 0
+        # Rows landed in replay state, counted ONCE at commit time for
+        # both the buffer-insert and direct-stage paths (the registry's
+        # no-double-count ledger; see _insert_group).
+        self._rows_committed = 0
         self._lock = TieredLock("service")
         # Guards ALL buffer mutation/reads: the commit thread's insert
         # races the learner thread's sample()/update_priorities()
@@ -206,6 +215,10 @@ class ReplayService:
         for t in self._workers:
             t.start()
         self._commit_thread.start()
+        # Unified-registry membership (d4pg_tpu/obs/registry): the
+        # service's consistent snapshot IS the provider — held weakly,
+        # last-registered service wins the slot, dropped on close().
+        REGISTRY.register_provider("ingest", self.ingest_stats)
 
     # -- actor-facing ------------------------------------------------------
     def add(self, batch: TransitionBatch, actor_id: str = "local",
@@ -249,13 +262,18 @@ class ReplayService:
         frame rejected past the timeout is counted in the shard's
         ``admit_fails`` rather than vanishing. A learner stall therefore
         backs pressure up into the sender exactly as at K=1."""
+        trace = None
         if codec == "raw":
             try:
-                actor_id, n, count = raw_frame_meta(payload)
+                # header-only: trace id/birth ride the v2 extension, so a
+                # sampled frame is traceable (and shed-accountable with a
+                # terminal span) before any column byte is parsed
+                actor_id, n, count, trace = raw_frame_meta_ex(payload)
             except Exception:
                 s = self._shards[shard % self.num_ingest_shards]
                 with s.cond:
                     s.decode_errors += 1
+                record_event("decode_error", shard=s.idx, where="admission")
                 return False
             data: object = payload
         else:
@@ -265,6 +283,7 @@ class ReplayService:
                 s = self._shards[shard % self.num_ingest_shards]
                 with s.cond:
                     s.decode_errors += 1
+                record_event("decode_error", shard=s.idx, where="admission")
                 return False
             n, codec, data = int(batch.obs.shape[0]), None, batch
         s = self._shards[shard % self.num_ingest_shards]
@@ -272,7 +291,8 @@ class ReplayService:
         if n == 0:
             return True
         return self._admit(s, data, codec, actor_id, n, count,
-                           block=s.shed_at is None, timeout=5.0)
+                           block=s.shed_at is None, timeout=5.0,
+                           trace=trace)
 
     def _route(self, actor_id: str, shard: int | None) -> _IngestShard:
         if shard is not None:
@@ -282,10 +302,12 @@ class ReplayService:
         return self._shards[hash(actor_id) % self.num_ingest_shards]
 
     def _admit(self, s: _IngestShard, data, codec, actor_id: str, rows: int,
-               count: bool, block: bool, timeout: float | None) -> bool:
+               count: bool, block: bool, timeout: float | None,
+               trace: tuple[int, float] | None = None) -> bool:
         with self._lock:
             self._pending += 1
         shed_seqs: list[int] = []
+        shed_tids: list[int] = []
         shed_batches = 0
         admitted = False
         with s.cond:
@@ -298,6 +320,8 @@ class ReplayService:
                     s.sheds += 1
                     s.shed_rows += old[4]
                     shed_seqs.append(old[0])
+                    if old[6] is not None:
+                        shed_tids.append(old[6][0])
                     shed_batches += 1
                 admitted = True
             elif len(s.q) >= s.capacity:
@@ -317,13 +341,32 @@ class ReplayService:
                 admitted = True
             if admitted:
                 seq = next(self._seq)
-                s.q.append((seq, data, codec, actor_id, rows, count))
+                s.q.append((seq, data, codec, actor_id, rows, count, trace))
                 s.rows_in += rows
                 s.cond.notify_all()
             else:
                 s.admit_fails += 1
+        # observability, all OUTSIDE the shard condition (obs locks are
+        # terminal, but tiered hold times stay honest): admission span +
+        # flight breadcrumb, terminal spans for everything shed here.
+        if admitted:
+            if trace is not None:
+                _tracer.begin(trace[0], trace[1])
+                _tracer.record_span(trace[0], "admission")
+            record_event("admit", shard=s.idx, actor=actor_id, rows=rows)
+            REGISTRY.counter("ingest.rows_admitted").inc(rows)
+        else:
+            record_event("admit_fail", shard=s.idx, actor=actor_id,
+                         rows=rows)
+            if trace is not None:
+                _tracer.begin(trace[0], trace[1])
+                _tracer.terminal_shed(trace[0])
         if shed_seqs:
             self._tombstone(shed_seqs)
+            record_event("shed", shard=s.idx, batches=shed_batches,
+                         seqs=shed_seqs[:8])
+            for tid in shed_tids:
+                _tracer.terminal_shed(tid)
         dropped = shed_batches + (0 if admitted else 1)
         if dropped:
             with self._lock:
@@ -347,6 +390,9 @@ class ReplayService:
             self._heartbeats[actor_id] = now
             if shard is not None:
                 self._owner[actor_id] = shard
+        if evicted_at is not None:
+            record_event("readmission", actor=actor_id,
+                         outage_s=round(now - evicted_at, 3))
 
     # -- learner-facing ----------------------------------------------------
     def sample(self, batch_size: int, beta: float = 0.4,
@@ -497,7 +543,9 @@ class ReplayService:
                 del self._heartbeats[a]
                 self._evicted[a] = now
                 self.evictions += 1
-            return stale
+        for a in stale:
+            record_event("eviction", actor=a)
+        return stale
 
     def evicted_actors(self) -> list[str]:
         with self._lock:
@@ -518,6 +566,7 @@ class ReplayService:
         with self._lock:
             merged = {
                 "env_steps": self._env_steps,
+                "rows_committed": self._rows_committed,
                 "pending": self._pending,
                 "evictions": self.evictions,
                 "readmissions": self.readmissions,
@@ -570,15 +619,20 @@ class ReplayService:
                     s.cond.notify_all()  # space freed: wake blocked adds
             if not items:
                 continue
-            out, dead, staged = [], [], 0
-            for seq, data, codec, actor_id, rows, count in items:
+            out, dead, dead_tids, staged = [], [], [], 0
+            for seq, data, codec, actor_id, rows, count, trace in items:
+                tid = trace[0] if trace is not None else None
                 if codec is not None:
                     try:
                         actor_id, batch, count = decode_frame(data, codec)
                     except Exception:
                         dead.append(seq)
+                        if tid is not None:
+                            dead_tids.append(tid)
                         continue
                     rows = int(batch.obs.shape[0])
+                    if tid is not None:
+                        _tracer.record_span(tid, "decode")
                 else:
                     batch = data
                 if self._direct_stage:
@@ -588,7 +642,11 @@ class ReplayService:
                     self.buffer.add_sharded(batch, s.idx, ticket=seq)
                     staged += rows
                     batch = None
-                out.append((seq, actor_id, batch, rows, count))
+                if tid is not None:
+                    # 'stage': rows copied into the shard's staging ring
+                    # (direct path) or handed to the ordered-merge inbox
+                    _tracer.record_span(tid, "stage")
+                out.append((seq, actor_id, batch, rows, count, tid))
             if dead or staged:
                 with s.cond:
                     s.decode_errors += len(dead)
@@ -599,10 +657,14 @@ class ReplayService:
                     self._skip.update(dead)
                 self._commit_cond.notify_all()
             if dead:
+                record_event("decode_error", shard=s.idx, tickets=dead[:8],
+                             n=len(dead))
+                for tid in dead_tids:
+                    _tracer.terminal_shed(tid)  # tombstoned, not leaked
                 with self._lock:
                     self._pending -= len(dead)
 
-    def _pop_ready(self, group: list) -> int:
+    def _pop_ready(self, group: list, shed_tids: list | None = None) -> int:
         """Pop the next run of in-ticket-order items (caller holds
         ``_commit_cond``). Tombstoned tickets are consumed and skipped.
 
@@ -613,7 +675,8 @@ class ReplayService:
         below, which would gate that shard's worker on a never-emptying
         inbox and wedge the shard permanently. Degrade-and-count instead:
         drop it, count it in ``order_breaks``; the caller settles its
-        ``_pending`` accounting outside this condition."""
+        ``_pending`` accounting — and the discards' terminal trace spans
+        (collected into ``shed_tids``) — outside this condition."""
         stale = 0
         while len(group) < self._COALESCE:
             while self._next_seq in self._skip:
@@ -622,9 +685,11 @@ class ReplayService:
             found = None
             for dq in self._out:
                 while dq and dq[0][0] < self._next_seq:
-                    dq.popleft()
+                    item = dq.popleft()
                     self.order_breaks += 1
                     stale += 1
+                    if shed_tids is not None and item[5] is not None:
+                        shed_tids.append(item[5])
                 if dq and dq[0][0] == self._next_seq:
                     found = dq.popleft()
                     break
@@ -641,21 +706,34 @@ class ReplayService:
         last_progress = time.monotonic()
         while True:
             group: list = []
+            shed_tids: list = []
             with self._commit_cond:
-                stale = self._pop_ready(group)
+                stale = self._pop_ready(group, shed_tids)
                 if not group:
                     if self._stop.is_set():
                         return
                     self._commit_cond.wait(timeout=0.1)
-                    stale += self._pop_ready(group)
+                    stale += self._pop_ready(group, shed_tids)
                 if group or stale:
                     # inbox slots freed: wake gated shard workers
                     self._commit_cond.notify_all()
                 backlog = any(self._out[i] for i in range(len(self._out)))
+            if group:
+                # merge-pop spans, recorded after the condition released
+                # (the pop order inside one group is ticket order; one
+                # timestamp per group is the honest granularity — the
+                # commit thread popped them in one critical section)
+                for item in group:
+                    if item[5] is not None:
+                        _tracer.record_span(item[5], "merge")
             if stale:
                 # discarded tickets never reach _insert_group; settle the
                 # flush() accounting here (never inside _commit_cond —
                 # lock order: _lock is not taken under the merge cond)
+                record_event("order_break", kind_detail="stale_discard",
+                             n=stale)
+                for tid in shed_tids:
+                    _tracer.terminal_shed(tid)
                 with self._lock:
                     self._pending -= stale
             if group:
@@ -666,16 +744,20 @@ class ReplayService:
                 # safety valve: a ticket vanished without a tombstone.
                 # Skip to the smallest ready ticket (counted) rather than
                 # wedging the whole ingest plane behind it.
+                advanced = False
                 with self._commit_cond:
                     heads = [dq[0][0] for dq in self._out if dq]
                     if heads and min(heads) > self._next_seq:
                         self.order_breaks += 1
+                        advanced = True
                         self._next_seq = min(heads)
                         # tombstones below the new floor can never be
                         # consumed by _pop_ready's equality walk; prune
                         # them or the set grows for the service lifetime
                         self._skip = {t for t in self._skip
                                       if t >= self._next_seq}
+                if advanced:
+                    record_event("order_break", kind_detail="floor_advance")
                 last_progress = time.monotonic()
 
     def _insert_group(self, group: list) -> None:
@@ -692,24 +774,35 @@ class ReplayService:
                 # BEFORE any of the group's rows are normalized, in
                 # admission-ticket order — same estimator as the
                 # per-batch loop, regardless of shard interleaving.
-                for j, (seq, aid, batch, rows, cnt) in enumerate(group):
+                for j, (seq, aid, batch, rows, cnt, tid) in enumerate(group):
                     if batch is None:
                         continue
                     self.obs_norm.update(batch.obs)
                     group[j] = (seq, aid, batch._replace(
                         obs=self.obs_norm.normalize(batch.obs),
                         next_obs=self.obs_norm.normalize(batch.next_obs),
-                    ), rows, cnt)
+                    ), rows, cnt, tid)
             with self._buffer_lock:
-                for _seq, _aid, batch, _rows, _cnt in group:
+                for _seq, _aid, batch, _rows, _cnt, _tid in group:
                     if batch is not None:  # None: already direct-staged
                         self.buffer.add(batch)
         finally:
+            committed = 0
             with self._lock:
-                for _seq, _aid, _batch, rows, count in group:
+                for _seq, _aid, _batch, rows, count, _tid in group:
                     if count:
                         self._env_steps += rows
+                    committed += rows
+                self._rows_committed += committed
                 self._pending -= len(group)
+            # The rows ledger counts each row ONCE, here, where replay
+            # state changed — NEVER at direct-stage time (staged_rows is
+            # a per-shard marker of which path ran, a SUBSET of these
+            # rows, not an addend; summing both double-counts the fast
+            # path — the K=1↔K=2 counter-equivalence test pins this).
+            REGISTRY.counter("ingest.rows_committed").inc(committed)
+            _tracer.mark_committed(
+                [tid for *_rest, tid in group if tid is not None])
 
     def flush(self, timeout: float = 5.0) -> None:
         """Block until every accepted batch has been committed."""
@@ -722,6 +815,7 @@ class ReplayService:
 
     def close(self) -> None:
         self.flush()
+        REGISTRY.unregister_provider("ingest", self.ingest_stats)
         self._stop.set()
         for s in self._shards:
             with s.cond:

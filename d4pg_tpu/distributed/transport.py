@@ -35,6 +35,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from d4pg_tpu.obs.flight import record_event
 from d4pg_tpu.replay.uniform import TransitionBatch
 
 _MAGIC = 0xD4F6  # v1 frames: npz payload (self-describing, slow to parse)
@@ -130,17 +131,34 @@ def _decode(payload: bytes) -> tuple[str, TransitionBatch, bool]:
 # needs — ``raw_frame_meta`` reads actor id / row count / count-flag from
 # the header WITHOUT touching the columns, so admission can route, shed
 # (with exact row accounting) and heartbeat before any decode happens.
+#
+# Header extension (the wire-to-grad tracing plane, d4pg_tpu/obs/trace):
+# the leading byte is a FLAG byte — bit 0 is the count-env-steps flag it
+# always carried (old encoders wrote exactly 0 or 1), bit 1 marks an
+# optional 16-byte trace extension (u64 trace id + f64 birth timestamp)
+# between the actor id and the field table. Frames WITHOUT the extension
+# are byte-identical to the original v2 format and decode unchanged
+# forever; the extension is readable from the header alone, so sampled
+# frames are traceable at zero-decode admission time (a shed frame gets
+# its terminal span without ever parsing a column).
 
-_RAW_PRE = struct.Struct("!BB")  # count_flag, len(actor_id)
+_RAW_PRE = struct.Struct("!BB")  # flags (bit0 count, bit1 trace), len(aid)
+_RAW_TRACE = struct.Struct("!Qd")  # trace id, birth timestamp
+_F_COUNT = 0x01
+_F_TRACE = 0x02
 
 
 def encode_raw(actor_id: str, batch: TransitionBatch,
-               count_env_steps: bool = True) -> bytes:
+               count_env_steps: bool = True,
+               trace: tuple[int, float] | None = None) -> bytes:
     aid = actor_id.encode()
     if len(aid) > 255:
         raise ValueError("actor_id longer than 255 bytes")
-    head = [_RAW_PRE.pack(int(count_env_steps), len(aid)), aid,
-            struct.pack("!B", len(batch))]
+    flags = (_F_COUNT if count_env_steps else 0) | (_F_TRACE if trace else 0)
+    head = [_RAW_PRE.pack(flags, len(aid)), aid]
+    if trace:
+        head.append(_RAW_TRACE.pack(int(trace[0]), float(trace[1])))
+    head.append(struct.pack("!B", len(batch)))
     blobs = []
     for v in batch:
         a = np.ascontiguousarray(v)
@@ -153,11 +171,17 @@ def encode_raw(actor_id: str, batch: TransitionBatch,
 
 
 def _raw_header(payload: bytes):
-    """Parse the v2 header: (actor_id, count, [(dtype, shape)], data_off)."""
-    count, laid = _RAW_PRE.unpack_from(payload, 0)
+    """Parse the v2 header: (actor_id, count, [(dtype, shape)], data_off,
+    trace) — ``trace`` is ``(trace_id, birth_ts)`` when the frame carries
+    the tracing extension, else None."""
+    flags, laid = _RAW_PRE.unpack_from(payload, 0)
     off = _RAW_PRE.size
     actor_id = payload[off:off + laid].decode()
     off += laid
+    trace = None
+    if flags & _F_TRACE:
+        trace = _RAW_TRACE.unpack_from(payload, off)
+        off += _RAW_TRACE.size
     (nf,) = struct.unpack_from("!B", payload, off)
     off += 1
     fields = []
@@ -169,20 +193,30 @@ def _raw_header(payload: bytes):
         shape = struct.unpack_from(f"!{ndim}I", payload, off)
         off += 4 * ndim
         fields.append((dtype, shape))
-    return actor_id, bool(count), fields, off
+    return actor_id, bool(flags & _F_COUNT), fields, off, trace
 
 
 def raw_frame_meta(payload: bytes) -> tuple[str, int, bool]:
     """(actor_id, n_rows, count_env_steps) from the header alone — no
     column bytes touched. The admission-time accounting hook for the
     sharded receiver (shed rows are counted exactly without a decode)."""
-    actor_id, count, fields, _ = _raw_header(payload)
-    n = int(fields[0][1][0]) if fields and fields[0][1] else 0
+    actor_id, n, count, _trace = raw_frame_meta_ex(payload)
     return actor_id, n, count
 
 
+def raw_frame_meta_ex(payload: bytes
+                      ) -> tuple[str, int, bool, tuple[int, float] | None]:
+    """``raw_frame_meta`` plus the trace extension ``(trace_id,
+    birth_ts)`` (or None) — still header-only, so a sampled frame is
+    traceable (and shed-countable with a terminal span) before any
+    column byte is parsed."""
+    actor_id, count, fields, _, trace = _raw_header(payload)
+    n = int(fields[0][1][0]) if fields and fields[0][1] else 0
+    return actor_id, n, count, trace
+
+
 def decode_raw(payload: bytes) -> tuple[str, TransitionBatch, bool]:
-    actor_id, count, fields, off = _raw_header(payload)
+    actor_id, count, fields, off, _trace = _raw_header(payload)
     if len(fields) != len(TransitionBatch._fields):
         raise ProtocolError(
             f"raw frame carries {len(fields)} fields, expected "
@@ -335,7 +369,8 @@ class TransitionSender(ReconnectingClient):
                  drop_on_timeout: bool = False,
                  backoff_base: float = 0.2, backoff_max: float = 5.0,
                  backoff_seed: Optional[int] = None,
-                 codec: str = "npz"):
+                 codec: str = "npz",
+                 trace_sample: float = 0.0):
         if codec not in CODECS:
             raise ValueError(f"unknown codec {codec!r}; one of {CODECS}")
         self.codec = codec
@@ -346,6 +381,16 @@ class TransitionSender(ReconnectingClient):
         self._backoff_base = backoff_base
         self._backoff_max = backoff_max
         self._backoff_rng = np.random.default_rng(backoff_seed)
+        # Wire-to-grad tracing (obs/trace): sample this fraction of raw
+        # frames and stamp them with a trace id + birth timestamp in the
+        # v2 header extension. Seeded alongside the backoff rng so a
+        # seeded fleet samples the same frames run to run; npz frames
+        # carry no extension, so trace_sample is inert at codec='npz'.
+        self._trace_sample = float(trace_sample)
+        self._trace_rng = np.random.default_rng(
+            None if backoff_seed is None else backoff_seed + 0x7ace)
+        self._trace_salt = hash(actor_id) & 0xFFFF
+        self.frames_traced = 0
         self.frames_sent = 0
         self.frames_dropped = 0
         self.retries = 0
@@ -359,8 +404,18 @@ class TransitionSender(ReconnectingClient):
         or ``max_retries`` reconnect attempts — is exhausted first."""
         import time
 
-        data = (encode_raw if self.codec == "raw" else _encode)(
-            self.actor_id, batch, count_env_steps)
+        if self.codec == "raw":
+            trace = None
+            if (self._trace_sample > 0.0
+                    and float(self._trace_rng.random()) < self._trace_sample):
+                from d4pg_tpu.obs.trace import new_trace_id
+
+                trace = (new_trace_id(self._trace_salt), time.monotonic())
+                self.frames_traced += 1
+            data = encode_raw(self.actor_id, batch, count_env_steps,
+                              trace=trace)
+        else:
+            data = _encode(self.actor_id, batch, count_env_steps)
         with self._lock:
             self._check_open()
             budget = self._retry_timeout if timeout is None else timeout
@@ -401,6 +456,11 @@ class TransitionSender(ReconnectingClient):
                 backoff = min(backoff * 2, self._backoff_max)
                 attempts += 1
                 self.retries += 1
+                # flight-recorder breadcrumb (obs/flight): reconnect
+                # attempts are exactly the context a receiver-side
+                # postmortem wants around a stall or deadlock
+                record_event("transport_retry", actor=self.actor_id,
+                             attempt=attempts)
                 try:
                     self._connect()
                 except (OSError, ConnectionError):
@@ -446,13 +506,15 @@ class CoalescingSender(TransitionSender):
                  drop_on_timeout: bool = False,
                  backoff_base: float = 0.2, backoff_max: float = 5.0,
                  backoff_seed: Optional[int] = None,
-                 codec: str = "npz"):
+                 codec: str = "npz",
+                 trace_sample: float = 0.0):
         super().__init__(host, port, actor_id,
                          connect_timeout=connect_timeout, secret=secret,
                          retry_timeout=retry_timeout, max_retries=max_retries,
                          drop_on_timeout=drop_on_timeout,
                          backoff_base=backoff_base, backoff_max=backoff_max,
-                         backoff_seed=backoff_seed, codec=codec)
+                         backoff_seed=backoff_seed, codec=codec,
+                         trace_sample=trace_sample)
         self._min_block = max(1, int(min_block))
         self._max_block = max(self._min_block, int(max_block))
         self._target = self._min_block

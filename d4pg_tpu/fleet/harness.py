@@ -24,6 +24,7 @@ runs' scripts comparable end-to-end.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 
@@ -34,7 +35,17 @@ from d4pg_tpu.distributed.replay_service import ReplayService
 from d4pg_tpu.distributed.transport import TransitionReceiver
 from d4pg_tpu.fleet.chaos import ChaosConfig, ChaosPolicy, StallGate
 from d4pg_tpu.fleet.sender import ThrottledSender, synthetic_block
+from d4pg_tpu.obs import flight as obs_flight
+from d4pg_tpu.obs import trace as obs_trace
+from d4pg_tpu.obs.registry import REGISTRY
 from d4pg_tpu.replay.uniform import ReplayBuffer
+
+# Default postmortem directory for flight-recorder dumps (deadlock /
+# crash / assertion / recorded hierarchy violation): the same evidence
+# tree the fleet artifacts live in.
+_EVIDENCE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "docs", "evidence", "fleet")
 
 
 @dataclasses.dataclass
@@ -78,6 +89,21 @@ class FleetConfig:
     # must show as 0). Record mode, not raise: a raise inside a shard
     # worker would read as a deadlock instead of a named violation.
     lock_debug: bool = True
+    # Wire-to-grad tracing (d4pg_tpu/obs/trace): fraction of frames each
+    # lane samples with a trace id + birth timestamp in the v2 header
+    # extension. 0 (default) keeps the plane exactly as shipped; > 0
+    # requires the raw codec to carry spans (npz frames are never
+    # traced) and arms the receiver-side recorder + a consumer lane that
+    # concurrently samples the service (so committed rows get a real
+    # grad-consumption mark, and the chaos run exercises the sample path
+    # under ingest load — previously untested concurrency).
+    trace_sample: float = 0.0
+    # Consumer-lane sampling cadence (Hz) when tracing is armed.
+    consume_hz: float = 50.0
+    # Flight-recorder dump directory (None = docs/evidence/fleet). Dumps
+    # fire on deadlock, run exception, or a recorded lock-hierarchy
+    # violation — the chaos postmortem.
+    flight_dir: str | None = None
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
     template_seed: int = 0
     connect_stagger_s: float = 0.002  # per-lane offset on the connect storm
@@ -155,6 +181,66 @@ class FleetHarness:
             locking.reset_stats()
             locking.enable_debug(raise_on_violation=False)
 
+    # -- observability plane -----------------------------------------------
+    def _arm_obs(self) -> None:
+        """Reset + arm the flight recorder (always) and the trace
+        recorder (when ``trace_sample`` > 0) for this run."""
+        cfg = self.config
+        obs_flight.RECORDER.reset()
+        obs_flight.record_event(
+            "fleet_run_start", n_actors=cfg.n_actors, mode=cfg.mode,
+            ingest_shards=cfg.ingest_shards, codec=cfg.resolved_codec(),
+            seed=cfg.chaos.seed)
+        obs_trace.RECORDER.reset()
+        if cfg.trace_sample > 0:
+            obs_trace.RECORDER.enable(cfg.trace_sample)
+
+    def _latency_report(self) -> dict | None:
+        """Latency block + disarm; None when tracing was off."""
+        if self.config.trace_sample <= 0:
+            return None
+        obs_trace.RECORDER.mark_grad()  # stamp the committed tail
+        block = obs_trace.RECORDER.latency_block()
+        obs_trace.RECORDER.disable()
+        return block
+
+    def _maybe_dump_flight(self, reason: str, extra: dict | None = None
+                           ) -> str | None:
+        directory = self.config.flight_dir or _EVIDENCE_DIR
+        try:
+            return obs_flight.RECORDER.dump(directory, reason, extra=extra)
+        except OSError as e:  # a failing dump must not mask the failure
+            print(f"flight-recorder dump failed: {e}", flush=True)
+            return None
+
+    def _start_consumer(self, service: ReplayService,
+                        stop: threading.Event) -> threading.Thread | None:
+        """The consumer lane: concurrently samples the service like a
+        learner would and marks grad consumption for committed traces.
+        Only runs when tracing is armed — it changes the plane's
+        concurrency profile (sample() under the buffer lock vs the
+        commit thread), which untraced runs must not silently gain."""
+        cfg = self.config
+        if cfg.trace_sample <= 0:
+            return None
+        period = 1.0 / max(1.0, cfg.consume_hz)
+        batch = min(64, cfg.block_rows * 4)
+
+        def consume():
+            while not stop.is_set():
+                if len(service) >= batch:
+                    try:
+                        service.sample(batch)
+                    except ValueError:
+                        pass  # raced an empty/shrinking buffer: benign
+                    obs_trace.RECORDER.mark_grad()
+                stop.wait(period)
+
+        t = threading.Thread(target=consume, daemon=True,
+                             name="fleet-consumer")
+        t.start()
+        return t
+
     def _lock_report(self) -> dict | None:
         """Snapshot + disarm. ``per_lock`` keys are tier names (all shard
         conditions fold into ``shard``, etc.); ``wait_ns`` is contended
@@ -186,11 +272,13 @@ class FleetHarness:
 
     def _make_receiver(self, service: ReplayService,
                        gate: StallGate | None = None) -> TransitionReceiver:
-        """K>1: shard-aware receiver forwarding UNDECODED payloads so
-        decode runs on the owning ingest shard's worker; K=1: the legacy
-        decode-in-connection-thread path, bit-compatible with PR 3."""
+        """K>1 (or K=1 on the raw codec): shard-aware receiver forwarding
+        UNDECODED payloads so decode runs on the owning ingest shard's
+        worker — the path that reads the v2 header's trace extension at
+        admission. K=1 on npz: the legacy decode-in-connection-thread
+        path, bit-compatible with PR 3."""
         cfg = self.config
-        if cfg.ingest_shards > 1:
+        if cfg.ingest_shards > 1 or cfg.resolved_codec() == "raw":
             def on_payload(payload, shard, codec):
                 if gate is not None:
                     gate.wait()
@@ -217,7 +305,17 @@ class FleetHarness:
             return self._run_processes()
         if cfg.mode == "actor":
             return self._run_actors()
+        try:
+            return self._run_threads()
+        except BaseException:
+            # crash/assertion postmortem: whatever the ring saw last
+            self._maybe_dump_flight("run_exception")
+            raise
+
+    def _run_threads(self) -> dict:
+        cfg = self.config
         self._arm_lock_sentinels()
+        self._arm_obs()
         service = self._make_service()
         gate = StallGate()
         receiver = self._make_receiver(service, gate)
@@ -233,6 +331,7 @@ class FleetHarness:
                 max_ticks=cfg.max_ticks, stop=stop,
                 connect_stagger_s=i * cfg.connect_stagger_s,
                 codec=cfg.resolved_codec(),
+                trace_sample=cfg.trace_sample,
             )
             for i in range(cfg.n_actors)
         ]
@@ -254,6 +353,7 @@ class FleetHarness:
                 now = time.monotonic() - t0
                 if stalls and now >= stalls[0][0]:
                     _, dur = stalls.pop(0)
+                    obs_flight.record_event("receiver_stall", dur_s=dur)
                     gate.stall()
                     monitor_stop.wait(dur)
                     gate.resume()
@@ -266,6 +366,8 @@ class FleetHarness:
         for t in threads:
             t.start()
         monitor_thread.start()
+        consumer_stop = threading.Event()
+        consumer_thread = self._start_consumer(service, consumer_stop)
 
         deadlocks = 0
         if cfg.max_ticks is not None:
@@ -290,6 +392,9 @@ class FleetHarness:
         _quiesce(service)
         receiver.close()
         service.flush(timeout=10.0)
+        consumer_stop.set()
+        if consumer_thread is not None:
+            consumer_thread.join(timeout=5.0)
         rows_inserted = service.env_steps - steps0
         stats = service.ingest_stats()
         if stats["pending"] > 0 or not service._drain_thread.is_alive():
@@ -309,6 +414,7 @@ class FleetHarness:
 
         cfg = self.config
         self._arm_lock_sentinels()
+        self._arm_obs()
         service = self._make_service()
         receiver = self._make_receiver(service)
         ctx = mp.get_context("spawn")
@@ -329,6 +435,9 @@ class FleetHarness:
                 "max_retries": cfg.max_retries, "max_ticks": cfg.max_ticks,
                 "connect_stagger_s": i * cfg.connect_stagger_s,
                 "codec": cfg.resolved_codec(),
+                # birth stamps use CLOCK_MONOTONIC — one timeline across
+                # processes on a host, so subprocess lanes trace fine
+                "trace_sample": cfg.trace_sample,
             }
             p = ctx.Process(target=_process_lane_main,
                             args=(kwargs, duration, out_q), daemon=True)
@@ -336,6 +445,8 @@ class FleetHarness:
             procs.append(p)
         t_start = time.monotonic()
         steps0 = service.env_steps
+        consumer_stop = threading.Event()
+        consumer_thread = self._start_consumer(service, consumer_stop)
         summaries, deadlocks = [], 0
         for _ in procs:
             try:
@@ -350,6 +461,9 @@ class FleetHarness:
         _quiesce(service)
         receiver.close()
         service.flush(timeout=10.0)
+        consumer_stop.set()
+        if consumer_thread is not None:
+            consumer_thread.join(timeout=5.0)
         rows_inserted = service.env_steps - steps0
         stats = service.ingest_stats()
         service.close()
@@ -379,6 +493,7 @@ class FleetHarness:
 
         cfg = self.config
         self._arm_lock_sentinels()
+        self._arm_obs()
         ticks = cfg.max_ticks if cfg.max_ticks is not None else 30
         acfg = ExperimentConfig(
             env=cfg.actor_env, num_envs=cfg.actor_num_envs, n_steps=2,
@@ -399,12 +514,15 @@ class FleetHarness:
                 target=_actor_lane_main,
                 args=(dataclasses.asdict(acfg), "127.0.0.1", receiver.port,
                       weight_server.port, f"actor-{i}", ticks,
-                      cfg.send_timeout, cfg.max_retries, out_q),
+                      cfg.send_timeout, cfg.max_retries, out_q,
+                      cfg.resolved_codec(), cfg.trace_sample),
                 daemon=True)
             p.start()
             procs.append(p)
         t_start = time.monotonic()
         steps0 = service.env_steps
+        consumer_stop = threading.Event()
+        consumer_thread = self._start_consumer(service, consumer_stop)
         summaries, deadlocks = [], 0
         # real actors pay a jax+env import per process: generous budget
         budget = 120.0 + ticks * cfg.actor_num_envs * 0.05
@@ -422,6 +540,9 @@ class FleetHarness:
         receiver.close()
         weight_server.close()
         service.flush(timeout=10.0)
+        consumer_stop.set()
+        if consumer_thread is not None:
+            consumer_thread.join(timeout=5.0)
         rows_inserted = service.env_steps - steps0
         stats = service.ingest_stats()
         if stats["pending"] > 0 or not service._drain_thread.is_alive():
@@ -431,6 +552,9 @@ class FleetHarness:
             "n_actors": cfg.n_actors,
             "mode": "actor",
             "locks": self._lock_report(),
+            "latency": self._latency_report(),
+            "trace_sample": cfg.trace_sample,
+            "flight_events": len(obs_flight.RECORDER),
             "actor_env": cfg.actor_env,
             "num_envs": cfg.actor_num_envs,
             "ticks_per_lane": ticks,
@@ -455,6 +579,22 @@ class FleetHarness:
         lane_recovery = [v for lane in lanes for v in lane["recovery_s"]]
         attempted = sum(lane["rows_attempted"] for lane in lanes)
         rows_per_sec = round(rows_inserted / dt, 1) if dt else 0.0
+        # publish the headline into the unified registry (gauges survive
+        # the run; export() is the one place that sees the whole process)
+        REGISTRY.gauge("fleet.rows_per_sec").set(rows_per_sec)
+        REGISTRY.gauge("fleet.deadlocks").set(deadlocks)
+        latency = self._latency_report()
+        flight_dump = None
+        violations = locks["hierarchy_violations"] if locks else 0
+        if deadlocks > 0 or violations > 0:
+            # the chaos postmortem: dump the event ring next to the
+            # artifacts so the failure ships its own context
+            reason = ("deadlock" if deadlocks > 0
+                      else "hierarchy_violation")
+            flight_dump = self._maybe_dump_flight(reason, extra={
+                "n_actors": cfg.n_actors, "deadlocks": deadlocks,
+                "hierarchy_violations": violations,
+                "seed": cfg.chaos.seed})
         return {
             "n_actors": cfg.n_actors,
             "mode": cfg.mode,
@@ -491,6 +631,13 @@ class FleetHarness:
             "receiver_stalls": stalls,
             "deadlocks": deadlocks,
             "locks": locks,
+            # wire-to-grad stage latency block (None when tracing off)
+            "latency": latency,
+            "trace_sample": cfg.trace_sample,
+            "frames_traced": sum(lane.get("frames_traced", 0)
+                                 for lane in lanes),
+            "flight_dump": flight_dump,
+            "flight_events": len(obs_flight.RECORDER),
             "ticks": sum(lane["ticks"] for lane in lanes),
             "chaos": dataclasses.asdict(cfg.chaos),
             "seed": cfg.chaos.seed,

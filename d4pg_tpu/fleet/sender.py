@@ -82,6 +82,7 @@ class ThrottledSender:
         stop: Optional[threading.Event] = None,
         connect_stagger_s: float = 0.0,
         codec: str = "npz",
+        trace_sample: float = 0.0,
     ):
         self.actor_index = actor_index
         self.actor_id = actor_id
@@ -97,7 +98,9 @@ class ThrottledSender:
         self._stop = stop if stop is not None else threading.Event()
         self._connect_stagger_s = connect_stagger_s
         self._codec = codec
+        self._trace_sample = float(trace_sample)
         # counters (absorbed across crash-replaced sender instances)
+        self.frames_traced = 0
         self.ticks = 0
         self.rows_attempted = 0
         self.rows_delivered = 0
@@ -123,12 +126,14 @@ class ThrottledSender:
             flush_interval=1e9, backoff_base=0.05, backoff_max=1.0,
             backoff_seed=self.chaos.config.seed * 100_003 + self.actor_index,
             codec=self._codec,
+            trace_sample=self._trace_sample,
         )
 
     def _absorb(self, sender: CoalescingSender) -> None:
         self.rows_delivered += sender.delivered_rows
         self.rows_dropped_backpressure += sender.dropped_rows
         self.retries += sender.retries
+        self.frames_traced += sender.frames_traced
 
     def _sleep(self, seconds: float) -> None:
         if seconds > 0:
@@ -214,6 +219,7 @@ class ThrottledSender:
             "retries": self.retries,
             "crashes": self.crashes,
             "failed_restarts": self.failed_restarts,
+            "frames_traced": self.frames_traced,
             "recovery_s": list(self.recovery_s),
             "latencies_ms": list(self.latencies_ms),
             "chaos_log": [tuple(ev) for ev in self.chaos.log],
@@ -244,7 +250,8 @@ def _process_lane_main(kwargs: dict, duration_s: float, out_queue) -> None:
 
 def _actor_lane_main(cfg_kwargs: dict, host: str, transitions_port: int,
                      weights_port: int, actor_id: str, max_ticks: int,
-                     send_timeout: float, max_retries, out_queue) -> None:
+                     send_timeout: float, max_retries, out_queue,
+                     codec: str = "npz", trace_sample: float = 0.0) -> None:
     """Entry point for a REAL actor lane (``FleetHarness(mode='actor')``):
     a spawned subprocess running the full ``actor_main.run_actor`` path —
     env pool, policy inference, n-step folding, coalescing transport,
@@ -263,6 +270,7 @@ def _actor_lane_main(cfg_kwargs: dict, host: str, transitions_port: int,
         steps = run_actor(ExperimentConfig(**cfg_kwargs), host,
                           transitions_port, weights_port, actor_id=actor_id,
                           max_ticks=max_ticks, send_timeout=send_timeout,
-                          send_retries=max_retries, drop_on_timeout=True)
+                          send_retries=max_retries, drop_on_timeout=True,
+                          codec=codec, trace_sample=trace_sample)
     finally:
         out_queue.put({"actor_id": actor_id, "env_steps": int(steps)})

@@ -86,6 +86,7 @@ def shard_sweep(
     duration_s: float = 10.0,
     rows_per_sec: float = 60.0,
     chaos: ChaosConfig | None = None,
+    trace_sample: float | None = None,
     **overrides,
 ) -> dict:
     """The multi-core receiver sweep: FIXED N, ingest shards K ∈ ``ks``.
@@ -98,13 +99,26 @@ def shard_sweep(
     K≥2 rows run the sharded plane end to end (v2 raw frames, zero-decode
     admission, shard-worker decode, ordered merge commit). Each row
     reports ``rows_per_sec_per_shard``; the summary adds scaling
-    efficiency vs K=1 and vs the priced single-core ceiling."""
+    efficiency vs K=1 and vs the priced single-core ceiling.
+
+    ``trace_sample`` (default ``obs.trace.DEFAULT_SAMPLE``) arms
+    wire-to-grad tracing on the K≥2 rows, so the scaling table carries
+    per-stage latency attribution NEXT TO ``lock_wait_ms`` — flat
+    scaling now names its stage, not just its lock. The K=1 legacy-npz
+    row is deliberately untraced (npz frames carry no extension; that
+    row must measure the plane exactly as PR 3 shipped it)."""
+    from d4pg_tpu.obs.trace import DEFAULT_SAMPLE
+
+    if trace_sample is None:
+        trace_sample = DEFAULT_SAMPLE
     chaos = default_chaos() if chaos is None else chaos
     rows = []
     for k in ks:
         cfg = FleetConfig(n_actors=int(n_actors), duration_s=duration_s,
                           rows_per_sec=rows_per_sec, ingest_shards=int(k),
-                          chaos=chaos, **overrides)
+                          chaos=chaos,
+                          trace_sample=(trace_sample if int(k) > 1 else 0.0),
+                          **overrides)
         result = FleetHarness(cfg).run()
         result.pop("chaos_log", None)
         rows.append(result)
@@ -133,6 +147,12 @@ def shard_sweep(
                 "hierarchy_violations": (
                     r["locks"]["hierarchy_violations"]
                     if r.get("locks") else None),
+                # per-K STAGE attribution (obs/trace spans): where a
+                # frame's time goes between socket write and commit —
+                # the column that turns "K didn't scale" into "decode
+                # saturated" vs "the merge floor stalled". None on the
+                # untraced K=1 legacy row.
+                "stage_ms": _stage_attribution(r),
             }
             for r in rows
         ],
@@ -146,6 +166,22 @@ def _lock_wait_ms(row: dict) -> float | None:
         return None
     return round(sum(per["wait_ns"]
                      for per in locks["per_lock"].values()) / 1e6, 3)
+
+
+# The stage pairs the scaling table surfaces (p95 of each, ms) — the
+# full histograms stay in the row's ``latency`` block.
+_STAGE_COLUMNS = ("wire_to_admission", "admission_to_decode",
+                  "decode_to_stage", "stage_to_merge", "merge_to_commit",
+                  "wire_to_commit", "wire_to_grad")
+
+
+def _stage_attribution(row: dict) -> dict | None:
+    """p95 per pipeline stage from the row's trace-span latency block."""
+    lat = row.get("latency")
+    if not lat or not lat.get("stages"):
+        return None
+    return {name: lat["stages"][name]["p95"]
+            for name in _STAGE_COLUMNS if name in lat["stages"]}
 
 
 def main(argv=None):
@@ -165,6 +201,11 @@ def main(argv=None):
                     metavar="K",
                     help="run the fixed-N shard sweep over these K values "
                          "instead of the N sweep (e.g. --shards_sweep 1 2 4)")
+    ap.add_argument("--trace_sample", type=float, default=None,
+                    help="wire-to-grad trace sampling rate (raw codec "
+                         "only; shard sweep default: obs.trace."
+                         "DEFAULT_SAMPLE on K>=2 rows, N sweep default: "
+                         "off)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--no_chaos", action="store_true",
                     help="clean-plane control run (all fault probs 0)")
@@ -177,12 +218,14 @@ def main(argv=None):
         artifact = shard_sweep(ks=tuple(ns.shards_sweep),
                                n_actors=max(ns.ns), duration_s=ns.seconds,
                                rows_per_sec=ns.rows_per_sec, chaos=chaos,
-                               block_rows=ns.block_rows, codec=ns.codec)
+                               block_rows=ns.block_rows, codec=ns.codec,
+                               trace_sample=ns.trace_sample)
     else:
         artifact = run_sweep(ns=tuple(ns.ns), duration_s=ns.seconds,
                              chaos=chaos, rows_per_sec=ns.rows_per_sec,
                              block_rows=ns.block_rows, mode=ns.mode,
-                             ingest_shards=ns.ingest_shards, codec=ns.codec)
+                             ingest_shards=ns.ingest_shards, codec=ns.codec,
+                             trace_sample=ns.trace_sample or 0.0)
     if ns.out:
         with open(ns.out, "w") as f:
             json.dump(artifact, f, indent=2)

@@ -77,23 +77,51 @@ class _StdoutSink:
 
 
 class MetricsBus:
+    """Sink fan-out with crash containment: a sink that raises in
+    ``write()``/``close()`` must never kill the learner loop (a full
+    disk under the CSV logger or a wedged TensorBoard writer is an
+    observability failure, not a training failure). A raising sink is
+    logged ONCE, disabled for the rest of the run, and counted in the
+    unified registry (``metrics_bus.sink_failures``) so the loss of
+    telemetry is itself telemetered."""
+
     def __init__(self, sinks: list | None = None, echo: bool = False):
         self._sinks: list = list(sinks or [])
         if echo:
             self._sinks.append(_StdoutSink())
         self._t0 = time.monotonic()
+        self._dead: list = []
 
     def add_sink(self, sink) -> None:
         self._sinks.append(sink)
 
+    def _disable(self, sink, op: str, err: Exception) -> None:
+        from d4pg_tpu.obs.registry import REGISTRY
+
+        if sink in self._sinks:
+            self._sinks.remove(sink)
+            self._dead.append(sink)
+        REGISTRY.counter("metrics_bus.sink_failures").inc()
+        print(f"metrics sink {type(sink).__name__} disabled after "
+              f"{op}() raised {type(err).__name__}: {err}", flush=True)
+
     def log(self, step: int, metrics: Mapping[str, float]) -> None:
-        for sink in self._sinks:
-            sink.write(step, metrics)
+        for sink in list(self._sinks):
+            try:
+                sink.write(step, metrics)
+            except Exception as e:  # noqa: BLE001 — containment is the point
+                self._disable(sink, "write", e)
 
     @property
     def elapsed(self) -> float:
         return time.monotonic() - self._t0
 
     def close(self) -> None:
-        for sink in self._sinks:
-            sink.close()
+        # dead sinks get a best-effort close too (they may hold an fd)
+        for sink in list(self._sinks) + list(self._dead):
+            try:
+                sink.close()
+            except Exception as e:  # noqa: BLE001
+                self._disable(sink, "close", e)
+        self._sinks = []
+        self._dead = []

@@ -111,6 +111,12 @@ class RecompileSentinel:
                 self._on_event)
         except (AttributeError, ValueError):
             pass  # older jax: listener stays registered but inert (_active)
+        # publish the bracketed count into the unified registry: bench
+        # artifacts and the fleet report read the same ledger instead of
+        # each keeping a private copy of "were there recompiles"
+        from d4pg_tpu.obs.registry import REGISTRY
+
+        REGISTRY.counter("profiling.recompiles").inc(self.compilations)
 
     def assert_clean(self, what: str = "steady-state region") -> None:
         if self.compilations:
@@ -171,3 +177,7 @@ class TransferSentinel:
         if self._stack is not None:
             self._stack.close()
             self._stack = None
+        from d4pg_tpu.obs.registry import REGISTRY
+
+        REGISTRY.counter("profiling.explicit_h2d").inc(self.h2d)
+        REGISTRY.counter("profiling.explicit_d2h").inc(self.d2h)

@@ -1,6 +1,6 @@
 """The jaxlint rule catalog.
 
-Nine rule families, each targeting a hazard that silently costs
+Ten rule families, each targeting a hazard that silently costs
 throughput or correctness on this stack (see docs/architecture.md "Static
 analysis & perf sentinels" for the rationale and suppression policy):
 
@@ -10,6 +10,7 @@ analysis & perf sentinels" for the rationale and suppression policy):
 - ``use-after-donation``   — reading a buffer after ``donate_argnums`` took it
 - ``tracer-leak``          — mutating outer state from inside a trace
 - ``device-put-in-loop``   — per-item H2D transfers in a Python loop
+- ``host-time-in-jit``     — host clock reads / obs-plane calls under a trace
 - ``lock-order``           — service/buffer lock acquired under a shard lock
 - ``lock-cycle``           — interprocedural ABBA cycle in the lock graph
 - ``unguarded-shared-write`` — shared attribute mutated off its owning lock
@@ -588,6 +589,89 @@ def rule_device_put_in_loop(ctx: ModuleContext) -> list[Finding]:
 
 
 # --------------------------------------------------------------------------
+# R10: host-time-in-jit
+# --------------------------------------------------------------------------
+
+# time-module entry points whose value is a HOST clock read: under a
+# trace they execute once at trace time and bake into the jaxpr as a
+# constant — every later call of the compiled function reports the same
+# "timestamp", silently. (The observability plane makes this hazard
+# live: span stamps are cheap enough that someone WILL eventually try
+# to time a jitted body from inside.)
+_TIME_FNS = {"time", "time_ns", "perf_counter", "perf_counter_ns",
+             "monotonic", "monotonic_ns", "process_time",
+             "process_time_ns", "thread_time", "thread_time_ns"}
+# bare-name clock reads distinctive enough to flag without a module
+# root (`from time import perf_counter`); bare `time()` stays unflagged
+# (too generic a name to claim).
+_TIME_BARE = _TIME_FNS - {"time"}
+# obs-plane entry points (d4pg_tpu/obs): recorder spans and registry
+# mutations are host side effects — traced code calling them records
+# once at trace time and never again (the tracer-leak failure mode,
+# with a clock attached).
+_OBS_FNS = {"record_span", "mark_grad", "mark_committed", "terminal_shed",
+            "new_trace_id", "record_event", "latency_block"}
+_OBS_METHODS = {"inc", "observe"}
+_OBS_RECV_HINTS = ("registry", "counter", "gauge", "histogram", "metric",
+                   "recorder", "tracer")
+
+
+def rule_host_time_in_jit(ctx: ModuleContext) -> list[Finding]:
+    """Flag host clock reads (``time.time()``/``perf_counter()``/...)
+    and observability-plane calls (trace spans, registry counters)
+    inside jit-traced code: they run at TRACE time, bake into the jaxpr
+    as constants, and silently lie on every compiled call. Move the
+    measurement to the dispatch site (bracket the jitted call), or
+    thread real timestamps in as arguments."""
+    findings: list[Finding] = []
+
+    def emit(node, msg):
+        findings.append(Finding(
+            ctx.path, node.lineno, node.col_offset, "host-time-in-jit", msg))
+
+    for func in all_functions(ctx):
+        if not ctx.is_traced(func):
+            continue
+        for node in walk_own(func):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = dotted_name(node.func) or ""
+            parts = dotted.split(".")
+            fn = parts[-1]
+            if fn in _TIME_FNS and len(parts) > 1 and parts[0] == "time":
+                emit(node, f"{dotted}() inside traced code reads the host "
+                           "clock at TRACE time and bakes it in as a "
+                           "constant — every compiled call reports the "
+                           "same timestamp; time the dispatch site "
+                           "instead")
+            elif fn in _TIME_BARE and len(parts) == 1:
+                emit(node, f"{fn}() inside traced code reads the host "
+                           "clock at TRACE time (constant thereafter); "
+                           "time the dispatch site instead")
+            elif fn in _OBS_FNS:
+                emit(node, f"observability call {dotted}() inside traced "
+                           "code runs ONCE at trace time — the span/"
+                           "event it records never fires again; hoist it "
+                           "to the dispatch site")
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _OBS_METHODS):
+                # receiver may be a name chain (counter.inc) or a call
+                # chain (REGISTRY.counter("x").inc); take whichever
+                # dotted path exists and look for obs-plane hints
+                recv = node.func.value
+                recv_dotted = dotted_name(
+                    recv.func if isinstance(recv, ast.Call) else recv) or ""
+                if any(h in part.lower() for part in recv_dotted.split(".")
+                       for h in _OBS_RECV_HINTS):
+                    emit(node, f"registry mutation .{node.func.attr}() on "
+                               f"'{recv_dotted}' inside traced code runs "
+                               "ONCE at trace time — the counter silently "
+                               "stops counting; hoist it to the dispatch "
+                               "site")
+    return findings
+
+
+# --------------------------------------------------------------------------
 # R7: lock-order
 # --------------------------------------------------------------------------
 
@@ -726,6 +810,10 @@ RULES: dict[str, Rule] = {r.id: r for r in [
          "jax.device_put called inside a Python loop — per-item H2D; "
          "coalesce into a block and transfer once",
          rule_device_put_in_loop),
+    Rule("host-time-in-jit",
+         "time.time()/perf_counter()/trace-span/registry calls inside "
+         "traced code — they run once at trace time and silently lie",
+         rule_host_time_in_jit),
     Rule("lock-order",
          "buffer/service lock acquired while holding a shard/ring leaf "
          "lock — the sharded-ingest deadlock shape",

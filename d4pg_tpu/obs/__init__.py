@@ -1,0 +1,43 @@
+"""Observability plane: wire-to-grad trace spans, the unified metrics
+registry, and the chaos flight recorder.
+
+Three stdlib-only modules (nothing here may import jax — the plane must
+be importable from the transport/locking layers that run before any
+backend exists):
+
+- ``obs.registry`` — ONE process-wide registry of named counters/gauges/
+  histograms plus *snapshot providers* (callables that produce a
+  consistent dict under their own locks — the PR-4 rule that every
+  counter is read under the lock that writes it). ``replay_service``,
+  ``staging``, ``fused_buffer``, ``core.locking``, the profiling
+  sentinels and the fleet harness all publish here; the bespoke
+  ``*_stats()`` dicts survive as thin views over the same snapshots.
+- ``obs.trace`` — sampled per-frame trace spans riding the v2 wire
+  codec's header extension: birth timestamp at the actor's socket
+  write, span timestamps at admission, decode, stage, merge-pop,
+  commit and grad-step consumption, aggregated into per-stage latency
+  histograms with end-to-end wire-to-grad as the headline series.
+- ``obs.flight`` — a bounded in-memory ring of recent structured
+  events (admissions, sheds, evictions, order-breaks, lock-hierarchy
+  violations, retries) the fleet harness dumps to
+  ``docs/evidence/fleet/`` on deadlock, crash or assertion, so a chaos
+  failure comes with a postmortem instead of a stack trace.
+
+Lock discipline: every lock in this package is named ``_mu`` — a plain
+``threading.Lock`` OUTSIDE the tiered hierarchy, deliberately terminal:
+no code path holding an ``_mu`` acquires any other lock, so the
+observability plane can be called from under any tiered lock without
+adding an edge the lock graph could cycle through.
+"""
+
+from d4pg_tpu.obs import flight, registry, trace
+from d4pg_tpu.obs.flight import FlightRecorder, record_event
+from d4pg_tpu.obs.registry import REGISTRY, MetricsRegistry
+from d4pg_tpu.obs.trace import DEFAULT_SAMPLE, TraceRecorder
+
+__all__ = [
+    "flight", "registry", "trace",
+    "FlightRecorder", "record_event",
+    "REGISTRY", "MetricsRegistry",
+    "DEFAULT_SAMPLE", "TraceRecorder",
+]

@@ -1,0 +1,93 @@
+"""Chaos flight recorder: the last N structured events, dumped on death.
+
+A chaos-smoke failure used to come with a stack trace and a pile of
+end-of-run counters — everything about *what* the plane was doing in
+the seconds before the wedge was already overwritten. The flight
+recorder is a bounded in-memory ring of recent structured events
+(admissions, sheds, evictions, order-breaks, lock-hierarchy
+violations, transport retries, receiver stalls) that the fleet harness
+dumps to ``docs/evidence/fleet/`` when a run ends in deadlock, crash,
+assertion, or a recorded hierarchy violation — a postmortem instead of
+a stack trace.
+
+Event volume: the ring is ``maxlen``-bounded (append drops the oldest),
+so per-frame admission events are safe to record at full ingest rate —
+they are exactly the context a postmortem needs ("what was the plane
+doing in the 2048 events before the violation").
+
+Lock discipline: one terminal ``_mu`` (obs/__init__); ``record`` is a
+lock round trip + deque append. Callers must record OUTSIDE their own
+critical sections where convenient — not for correctness (``_mu`` is
+terminal) but to keep tiered-lock hold times honest.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+
+class FlightRecorder:
+    def __init__(self, maxlen: int = 2048):
+        self._mu = threading.Lock()
+        self._ring: deque = deque(maxlen=int(maxlen))
+        self._seq = 0
+        self.enabled = True
+
+    def record(self, kind: str, **fields) -> None:
+        if not self.enabled:
+            return
+        t = time.monotonic()
+        with self._mu:
+            self._seq += 1
+            self._ring.append({"seq": self._seq, "t": round(t, 6),
+                               "kind": kind, **fields})
+
+    def events(self) -> list[dict]:
+        with self._mu:
+            return list(self._ring)
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._ring)
+
+    def reset(self) -> None:
+        with self._mu:
+            self._ring.clear()
+            self._seq = 0
+
+    def dump(self, directory: str, reason: str,
+             extra: dict | None = None) -> str:
+        """Write the ring as a JSON postmortem; returns the path. The
+        filename carries a wall-clock stamp + the reason so a directory
+        of dumps reads as an incident log."""
+        os.makedirs(directory, exist_ok=True)
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                       for c in reason)[:40]
+        path = os.path.join(directory, f"flight_{stamp}_{safe}.json")
+        payload = {
+            "reason": reason,
+            "dumped_at": stamp,
+            "n_events": len(self),
+            "events": self.events(),
+        }
+        if extra:
+            payload["context"] = extra
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=2, default=str)
+        return path
+
+
+# THE process-wide recorder: the receiver-side planes (replay service,
+# locking sentinels, transport retries) publish here, the fleet harness
+# dumps it.
+RECORDER = FlightRecorder()
+
+
+def record_event(kind: str, **fields) -> None:
+    """Module-level convenience over the process recorder."""
+    RECORDER.record(kind, **fields)

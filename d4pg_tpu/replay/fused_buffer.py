@@ -44,6 +44,7 @@ from functools import partial
 
 import numpy as np
 
+from d4pg_tpu.obs.registry import REGISTRY
 from d4pg_tpu.replay import device_per as dper
 from d4pg_tpu.replay.device_ring import DeviceStore, block_write
 from d4pg_tpu.replay.uniform import TransitionBatch
@@ -273,6 +274,9 @@ class FusedDeviceReplay:
                  if self._device is not None else jax.device_put(views))
         self._staging.pop(n)
         self._inflight = (frame, n)
+        # one registry inc per BLOCK (never per row): the unified ledger
+        # of the fused plane's H2D traffic (obs/registry)
+        REGISTRY.counter("fused.rows_staged").inc(n)
         return n
 
     def commit_staged(self) -> int:  # jaxlint: guarded-by=_buffer_lock
@@ -293,6 +297,8 @@ class FusedDeviceReplay:
         self._store.swap_arrays(storage)
         self.head = int((self.head + n) % self.capacity)
         self.size = int(min(self.size + n, self.capacity))
+        REGISTRY.counter("fused.rows_committed").inc(n)
+        REGISTRY.counter("fused.blocks_committed").inc()
         return n
 
     def drain(self) -> int:

@@ -22,6 +22,7 @@ from typing import Callable, Iterator
 import jax
 
 from d4pg_tpu.core.locking import TieredLock
+from d4pg_tpu.obs.registry import REGISTRY
 
 
 class MultiRingStaging:
@@ -75,6 +76,10 @@ class MultiRingStaging:
     def push(self, batch, shard: int = 0, ticket: int | None = None) -> None:
         i = shard % self.shards
         ring, records = self._rings[i], self._records[i]
+        # per-frame registry inc, OUTSIDE the ring leaf lock (the obs
+        # plane is terminal-locked but ring hold times stay honest)
+        REGISTRY.counter("staging.rows_pushed").inc(
+            int(batch.obs.shape[0]))
         with self._ring_locks[i]:
             t = next(self._ticket) if ticket is None else ticket
             n = min(int(batch.obs.shape[0]), ring.size)

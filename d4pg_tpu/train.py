@@ -757,6 +757,17 @@ def train(cfg: ExperimentConfig) -> dict:
 
     ingest = IngestOverlap(service)
 
+    # Wire-to-grad tracing (docs/architecture.md "Observability plane"):
+    # arm the receiver-side span recorder; frames sampled by raw-codec
+    # remote actors get their grad-consumption span stamped right after
+    # each fused dispatch below (the host-side proxy for "a grad step
+    # consumed these rows" — the device runs async and observing the
+    # kernel would cost the sync the plane exists to avoid).
+    from d4pg_tpu.obs.trace import RECORDER as trace_recorder
+
+    if cfg.trace_sample > 0:
+        trace_recorder.enable(cfg.trace_sample)
+
     def train_steps_fused(n: int):
         """n fused updates. The only host work per chunk is moving staged
         actor rows onto the device, and that is overlapped: block t's
@@ -780,6 +791,9 @@ def train(cfg: ExperimentConfig) -> dict:
             else:
                 state, metrics = fn(state, buffer.storage, buffer.size)
             ingest.stage()
+            # traces whose rows committed before this dispatch are now
+            # consumed; near-free no-op when nothing is pending
+            trace_recorder.mark_grad()
             done += k
             lstep += k
             if cfg.async_actors:
@@ -1025,6 +1039,13 @@ def train(cfg: ExperimentConfig) -> dict:
                 })
             if rate is not None:
                 last_metrics["grad_steps_per_sec"] = round(rate, 2)
+            if cfg.trace_sample > 0:
+                # wire-to-grad headline onto the metrics bus: the p95 of
+                # the end-to-end span over the recent trace window
+                lat = trace_recorder.latency_block()
+                if lat["wire_to_grad"]["n"]:
+                    last_metrics["wire_to_grad_p95_ms"] = \
+                        lat["wire_to_grad"]["p95"]
             last_metrics["cycle_time_s"] = round(time.monotonic() - cycle_t0, 4)
             # Failure detection/recovery (SURVEY.md §5): stale heartbeats
             # reach the metrics bus (not just stdout); dead spawned actor

@@ -261,7 +261,19 @@ def test_fleet_k2_bitwise_replay_equivalence_vs_k1():
                   "discount"):
         np.testing.assert_array_equal(
             getattr(s1.buffer, field), getattr(s2.buffer, field))
-    assert s2.ingest_stats()["order_breaks"] == 0
+    # counter-total equivalence (obs plane, no-double-count contract):
+    # the unified row ledger must agree bitwise between the K=1 and K=2
+    # planes — admitted == committed == env_steps on a clean feed, with
+    # NO contribution from which internal path (drain vs direct-stage)
+    # carried the rows
+    st1, st2 = s1.ingest_stats(), s2.ingest_stats()
+    assert st2["order_breaks"] == 0
+    for key in ("env_steps", "rows_committed", "sheds", "shed_rows",
+                "decode_errors", "admit_fails"):
+        assert st1[key] == st2[key], key
+    rows_in1 = sum(p["rows_in"] for p in st1["per_shard"])
+    rows_in2 = sum(p["rows_in"] for p in st2["per_shard"])
+    assert rows_in1 == rows_in2 == st1["rows_committed"] == 8 * len(feed)
     s1.close()
     s2.close()
 

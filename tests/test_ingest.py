@@ -206,6 +206,10 @@ def test_service_direct_stage_k2_bitwise_equals_k1(rng):
     sharded fused buffer engages the direct-stage fast path (workers
     copy rows into their own ring, no buffer lock) and must still land
     the identical device state as the K=1 plane."""
+    from d4pg_tpu.obs.registry import REGISTRY
+
+    admitted0 = REGISTRY.counter("ingest.rows_admitted").value
+    committed0 = REGISTRY.counter("ingest.rows_committed").value
     f1 = FusedDeviceReplay(256, OBS, ACT, block_rows=32)
     f2 = FusedDeviceReplay(256, OBS, ACT, block_rows=32, ingest_shards=2)
     s1 = ReplayService(f1)
@@ -224,8 +228,24 @@ def test_service_direct_stage_k2_bitwise_equals_k1(rng):
                                       np.asarray(f2.storage[f][:64]))
     np.testing.assert_array_equal(np.asarray(f1.trees.sum_tree),
                                   np.asarray(f2.trees.sum_tree))
-    stats = s2.ingest_stats()
-    assert sum(p["staged_rows"] for p in stats["per_shard"]) == 64
+    # counter-total bitwise equivalence (the no-double-count contract):
+    # the K=2 service ran every row through add_sharded's direct-stage
+    # fast path (staged_rows == 64), but its row LEDGER must be
+    # identical to K=1's — rows_committed counts each row once at the
+    # ordered commit, never again at staging; naive "rows_in +
+    # staged_rows" style aggregation would report the fast path twice.
+    st1, st2 = s1.ingest_stats(), s2.ingest_stats()
+    assert sum(p["staged_rows"] for p in st2["per_shard"]) == 64
+    assert sum(p["staged_rows"] for p in st1["per_shard"]) == 0
+    assert st1["rows_committed"] == st2["rows_committed"] == 64
+    assert sum(p["rows_in"] for p in st1["per_shard"]) \
+        == sum(p["rows_in"] for p in st2["per_shard"]) == 64
+    # ...and the process-wide registry ledger agrees: exactly 2x64 rows
+    # admitted AND committed across the two services, no fast-path echo
+    assert REGISTRY.counter("ingest.rows_admitted").value \
+        - admitted0 == 128
+    assert REGISTRY.counter("ingest.rows_committed").value \
+        - committed0 == 128
     s1.close()
     s2.close()
 

@@ -302,7 +302,8 @@ def test_rule_catalog_covers_all_families():
     assert set(RULES) == {
         "prng-key-reuse", "host-sync-in-jit", "recompile-hazard",
         "use-after-donation", "tracer-leak", "device-put-in-loop",
-        "lock-order", "lock-cycle", "unguarded-shared-write",
+        "host-time-in-jit", "lock-order", "lock-cycle",
+        "unguarded-shared-write",
     }
     # the lock-graph families analyze whole programs, not single modules
     assert RULES["lock-cycle"].scope == "program"
@@ -407,6 +408,83 @@ def test_device_put_in_loop_clean_patterns():
 def test_syntax_error_reported_not_raised(tmp_path):
     res = lint_source("def broken(:\n", "broken.py")
     assert res.errors and not res.clean
+
+
+# ------------------------------------------- R10: host-time-in-jit --------
+
+def test_host_time_in_jit_fires_on_clock_reads():
+    out = findings("""
+        import time
+        from time import perf_counter
+        import jax
+
+        @jax.jit
+        def step(x):
+            t0 = time.time()
+            t1 = time.perf_counter()
+            t2 = perf_counter()
+            return x * (t1 - t0) + t2
+        """, "host-time-in-jit")
+    assert len(out) == 3
+    assert "trace time" in out[0].message.lower() \
+        or "TRACE time" in out[0].message
+
+
+def test_host_time_in_jit_fires_transitively_and_on_obs_calls():
+    # update_step is only REACHED from a jitted wrapper — the taint must
+    # propagate; registry/span calls are host side effects that fire
+    # once at trace time and never again
+    out = findings("""
+        import time
+        import jax
+        from d4pg_tpu.obs.trace import RECORDER
+
+        def update_step(state, batch):
+            RECORDER.record_span(1, "grad")
+            REGISTRY.counter("steps").inc()
+            return state, time.monotonic()
+
+        update = jax.jit(lambda s, b: update_step(s, b))
+        """, "host-time-in-jit")
+    assert len(out) == 3
+
+
+def test_host_time_in_jit_clean_patterns():
+    out = findings("""
+        import time
+        import jax
+
+        def host_loop(update, state, batch):
+            # clock reads at the DISPATCH site are the correct pattern
+            t0 = time.perf_counter()
+            state, m = update(state, batch)
+            return state, time.perf_counter() - t0
+
+        @jax.jit
+        def step(x, t_wall):
+            # timestamps threaded in as arguments are real data
+            return x * t_wall
+
+        def bare_time_not_claimed(time):
+            # a user-defined callable named `time` is not the module
+            return time()
+        """, "host-time-in-jit")
+    assert out == []
+
+
+def test_host_time_in_jit_suppressible():
+    res = lint_source(textwrap.dedent("""
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            # trace-time stamp is INTENTIONAL here: compile-era marker
+            t = time.time()  # jaxlint: disable=host-time-in-jit
+            return x
+        """), "fixture.py")
+    assert [f for f in res.findings if f.rule == "host-time-in-jit"] == []
+    assert any(f.rule == "host-time-in-jit" for f in res.suppressed)
 
 
 # ------------------------------------------------- R8: lock-cycle ---------

@@ -415,8 +415,8 @@ def test_stale_ticket_below_merge_floor_discarded_not_wedged():
     with svc._commit_cond:
         svc._next_seq = 5  # the valve already advanced past ticket 3
         svc._seq = itertools.count(6)
-        svc._out[0].append((3, "a0", b, 8, True))  # the late ticket
-        svc._out[1].append((5, "a1", b, 8, True))  # current floor head
+        svc._out[0].append((3, "a0", b, 8, True, None))  # the late ticket
+        svc._out[1].append((5, "a1", b, 8, True, None))  # current floor head
         svc._commit_cond.notify_all()
     svc.flush(timeout=5.0)
     stats = svc.ingest_stats()
@@ -446,7 +446,7 @@ def test_order_break_valve_prunes_stale_tombstones(monkeypatch):
     with svc._commit_cond:
         svc._skip.update({1, 2})  # tombstones below the coming jump
         svc._seq = itertools.count(8)
-        svc._out[0].append((7, "a0", b, 8, True))  # tickets 0-6 vanished
+        svc._out[0].append((7, "a0", b, 8, True, None))  # tickets 0-6 vanished
         svc._commit_cond.notify_all()
     deadline = time.monotonic() + 5.0
     while time.monotonic() < deadline and svc.env_steps < 8:
