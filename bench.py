@@ -440,7 +440,12 @@ def bench_fleet(ns=(8, 32, 64, 128, 256), duration_s: float = 10.0,
     as ``python bench.py --fleet`` (persists the artifact under
     docs/evidence/fleet/)."""
     from d4pg_tpu.fleet.chaos import ChaosConfig
-    from d4pg_tpu.fleet.sweep import default_chaos, run_sweep, shard_sweep
+    from d4pg_tpu.fleet.sweep import (
+        default_chaos,
+        run_recovery,
+        run_sweep,
+        shard_sweep,
+    )
 
     cc = default_chaos(seed) if chaos else ChaosConfig(seed=seed)
     artifact = run_sweep(ns=ns, duration_s=duration_s, chaos=cc)
@@ -455,6 +460,14 @@ def bench_fleet(ns=(8, 32, 64, 128, 256), duration_s: float = 10.0,
     artifact["latency"] = bench_fleet_latency(
         n_actors=max(64, min(ns)), duration_s=duration_s, seed=seed,
         chaos=cc, rows_per_sec=shard_rows_per_sec)
+    # crash-recovery block: one service_chaos run (N>=64, K=2, full fault
+    # set + two seeded learner kills) — MTTR, fence/loss ledger, restart
+    # counts — plus the deterministic bitwise restore-vs-oracle probe.
+    # Schema-checked in tier-1 (tests/test_recovery.py) like the latency
+    # block, so later PRs can't silently drop it.
+    artifact["recovery"] = run_recovery(
+        n_actors=max(64, min(ns)), duration_s=duration_s,
+        ingest_shards=2, seed=seed)
     return artifact
 
 
@@ -705,8 +718,17 @@ def main():
                                 "docs", "evidence", "fleet")
         os.makedirs(evidence, exist_ok=True)
         stamp = time.strftime("%Y%m%d-%H%M%S")
-        with open(os.path.join(evidence, f"fleet_{stamp}.json"), "w") as f:
+        # pid suffix: same-second writers (two bench invocations, a CI
+        # matrix) get distinct names while lexical order stays
+        # chronological; prune keeps the evidence tree bounded (newest 8
+        # fleet artifacts — flight dumps have their own retention)
+        from d4pg_tpu.obs.flight import prune_artifacts
+
+        with open(os.path.join(
+                evidence, f"fleet_{stamp}_{os.getpid():07d}.json"), "w") as f:
             json.dump(artifact, f, indent=2)
+        prune_artifacts(evidence, "fleet_",
+                        int(os.environ.get("D4PG_FLEET_KEEP", "8")))
         print(json.dumps(artifact))
         return
     if "--sharded-overhead" in sys.argv:

@@ -56,6 +56,7 @@ def run_actor(
     drop_on_timeout: bool = False,
     codec: str = "npz",
     trace_sample: float = 0.0,
+    expect_generation: bool = False,
 ) -> int:
     cfg = cfg.resolve()
     obs_dim, act_dim, obs_dtype = infer_dims(cfg)
@@ -74,13 +75,19 @@ def run_actor(
     # --trace_sample: fraction of raw frames stamped with a trace id +
     # birth timestamp (the wire-to-grad tracing plane, d4pg_tpu/obs);
     # inert at codec='npz' — only v2 headers carry the extension.
+    # --expect_generation: read the service-generation greeting after the
+    # handshake and stamp raw frames with it, so a learner that restarted
+    # and restored a snapshot can fence pre-crash frames at admission
+    # (the crash-recovery plane's exactly-once rule); requires a greeting
+    # receiver (train.py serve mode always greets).
     sender = CoalescingSender(learner_host, transitions_port,
                               actor_id=actor_id, secret=secret,
                               retry_timeout=send_timeout,
                               max_retries=send_retries,
                               drop_on_timeout=drop_on_timeout,
                               codec=codec,
-                              trace_sample=trace_sample)
+                              trace_sample=trace_sample,
+                              expect_generation=expect_generation)
     weights = WeightClient(learner_host, weights_port, secret=secret)
     actor_cfg = ActorConfig(
         epsilon_0=cfg.epsilon_0, min_epsilon=cfg.min_epsilon,
@@ -149,6 +156,7 @@ def run_local_actor_process(
     weights_port: int,
     actor_id: str,
     secret: str | None = None,
+    expect_generation: bool = False,
 ) -> None:
     """Entry point for locally SPAWNED actor processes (``train.py
     --actor_procs N`` — the proper replacement for the reference's
@@ -163,7 +171,8 @@ def run_local_actor_process(
     jax.config.update("jax_platforms", "cpu")
     try:
         run_actor(cfg, learner_host, transitions_port, weights_port,
-                  actor_id=actor_id, secret=secret)
+                  actor_id=actor_id, secret=secret,
+                  expect_generation=expect_generation)
     except KeyboardInterrupt:
         pass
 
@@ -210,6 +219,12 @@ def main(argv=None):
                         "trace id + birth timestamp in the v2 header "
                         "extension (requires --codec raw; the learner "
                         "aggregates per-stage latency histograms)")
+    p.add_argument("--expect_generation", type=int, choices=(0, 1), default=0,
+                   help="1: read the learner's service-generation greeting "
+                        "on connect and stamp raw frames with it, so a "
+                        "restarted learner fences pre-crash frames instead "
+                        "of double-inserting them (requires a greeting "
+                        "learner, e.g. train.py serve mode)")
     ns = p.parse_args(argv)
     if ns.actor_device == "cpu":
         # Acting runs on host CPU; force the platform BEFORE any jax call
@@ -229,7 +244,8 @@ def main(argv=None):
                       send_timeout=ns.send_timeout,
                       send_retries=ns.send_retries,
                       drop_on_timeout=bool(ns.drop_on_timeout),
-                      codec=ns.codec, trace_sample=ns.trace_sample)
+                      codec=ns.codec, trace_sample=ns.trace_sample,
+                      expect_generation=bool(ns.expect_generation))
     print(f"collected {steps} env steps")
 
 

@@ -120,6 +120,7 @@ class ReplayService:
         obs_norm=None,
         shed_watermark: float | None = None,
         num_ingest_shards: int = 1,
+        generation: int = 0,
     ):
         """``shed_watermark`` (fraction of ``ingest_capacity``, fleet-plane
         degradation): when an ingest shard's deque stands at or above the
@@ -153,6 +154,16 @@ class ReplayService:
         # both the buffer-insert and direct-stage paths (the registry's
         # no-double-count ledger; see _insert_group).
         self._rows_committed = 0
+        # Crash-recovery plane (all under self._lock): the service
+        # generation id. Raw frames stamped with an OLDER generation are
+        # fenced at admission — they were encoded against a pre-crash
+        # service and may duplicate rows already inside the restored
+        # snapshot (transport.py "Generation extension"). restore() bumps
+        # past the snapshot's generation; a supervisor restarting WITHOUT
+        # a snapshot passes ``generation`` explicitly.
+        self._generation = int(generation)
+        self._fenced_frames = 0
+        self._fenced_rows = 0
         self._lock = TieredLock("service")
         # Guards ALL buffer mutation/reads: the commit thread's insert
         # races the learner thread's sample()/update_priorities()
@@ -263,12 +274,13 @@ class ReplayService:
         ``admit_fails`` rather than vanishing. A learner stall therefore
         backs pressure up into the sender exactly as at K=1."""
         trace = None
+        gen = None
         if codec == "raw":
             try:
                 # header-only: trace id/birth ride the v2 extension, so a
                 # sampled frame is traceable (and shed-accountable with a
                 # terminal span) before any column byte is parsed
-                actor_id, n, count, trace = raw_frame_meta_ex(payload)
+                actor_id, n, count, trace, gen = raw_frame_meta_ex(payload)
             except Exception:
                 s = self._shards[shard % self.num_ingest_shards]
                 with s.cond:
@@ -288,6 +300,30 @@ class ReplayService:
             n, codec, data = int(batch.obs.shape[0]), None, batch
         s = self._shards[shard % self.num_ingest_shards]
         self.heartbeat(actor_id, shard=s.idx)
+        fenced = False
+        if gen is not None:
+            # generation fence (crash recovery): a frame stamped with a
+            # PRE-restart generation was encoded before the crash and
+            # retried verbatim — its rows may already sit inside the
+            # restored snapshot (the sender's sendall could have landed
+            # before the kill). Admitting it risks a duplicate; fencing
+            # it is a DECLARED loss (fenced_rows), keeping recovery
+            # exactly-once w.r.t. committed rows.
+            with self._lock:
+                if gen < self._generation:
+                    self._fenced_frames += 1
+                    self._fenced_rows += n
+                    fenced = True
+        if fenced:
+            REGISTRY.counter("ingest.rows_fenced").inc(n)
+            record_event("generation_fenced", shard=s.idx, actor=actor_id,
+                         rows=n, frame_gen=gen)
+            if trace is not None:
+                # the traced frame ends HERE: a fence is a terminal
+                # outcome (like a shed), never an orphan span
+                _tracer.begin(trace[0], trace[1])
+                _tracer.terminal_shed(trace[0])
+            return True
         if n == 0:
             return True
         return self._admit(s, data, codec, actor_id, n, count,
@@ -489,6 +525,65 @@ class ReplayService:
         with self._buffer_lock:
             self.buffer.load_state_dict(d)
 
+    def snapshot(self, quiesce_timeout: float = 10.0) -> dict:
+        """Consistent snapshot of the SERVING state at a quiesced cut:
+        buffer columns + PER tree (``state_dict`` — the fused buffer
+        drains its staging rings first, so ring heads collapse into the
+        cut), the admission-ticket/commit floor, the row ledger and the
+        service generation. The cut is quiesced by ``flush`` (every
+        admitted batch committed), then captured lock-by-lock in the
+        ``ingest_stats`` pattern — strictly SEQUENTIAL acquisitions, so
+        the tier hierarchy gains no new edges. Restoring this dict into
+        a fresh service (``restore``) resumes at exactly this cut;
+        persisted next to the orbax learner checkpoint by
+        ``io/checkpoint.py`` so learner and replay restore together."""
+        self.flush(timeout=quiesce_timeout)
+        with self._buffer_lock:
+            buf = self.buffer.state_dict()
+        with self._commit_cond:
+            next_seq = self._next_seq
+        with self._lock:
+            return {
+                "schema": 1,
+                "buffer": buf,
+                "next_seq": next_seq,
+                "env_steps": self._env_steps,
+                "rows_committed": self._rows_committed,
+                "generation": self._generation,
+            }
+
+    def restore(self, snap: dict) -> None:
+        """Load a ``snapshot`` cut into this (fresh or quiesced) service:
+        buffer + PER tree, ticket floor (the admission counter resumes
+        ABOVE every committed ticket, so merge order stays monotone
+        across the restart) and the row ledger. The service generation
+        is bumped PAST the snapshot's — every raw frame encoded against
+        the pre-crash service now fences at admission."""
+        if not isinstance(snap, dict) or "buffer" not in snap:
+            raise ValueError("not a replay service snapshot (no buffer cut)")
+        with self._buffer_lock:
+            self.buffer.load_state_dict(snap["buffer"])
+        floor = int(snap.get("next_seq", 0))
+        with self._commit_cond:
+            self._next_seq = floor
+            self._seq = itertools.count(floor)
+            self._skip.clear()
+            for dq in self._out:
+                dq.clear()
+            self._commit_cond.notify_all()
+        with self._lock:
+            self._env_steps = int(snap.get("env_steps", 0))
+            self._rows_committed = int(snap.get("rows_committed", 0))
+            self._generation = max(self._generation,
+                                   int(snap.get("generation", 0)) + 1)
+
+    @property
+    def generation(self) -> int:
+        """Current service generation (the id the receiver's greeting
+        hands to connecting senders — transport.TransitionReceiver)."""
+        with self._lock:
+            return self._generation
+
     @property
     def env_steps(self) -> int:
         with self._lock:
@@ -573,6 +668,9 @@ class ReplayService:
                 "recovery_s": list(self._recovery_s),
                 "live_actors": len(self._heartbeats),
                 "evicted": len(self._evicted),
+                "generation": self._generation,
+                "fenced_frames": self._fenced_frames,
+                "fenced_rows": self._fenced_rows,
             }
         merged.update({
             "queue_depth": sum(p["queue_depth"] for p in per_shard),
@@ -815,6 +913,17 @@ class ReplayService:
 
     def close(self) -> None:
         self.flush()
+        self.kill()
+
+    def kill(self) -> None:
+        """SIGKILL-equivalent teardown (the chaos supervisor's weapon):
+        stop the ingest threads WITHOUT flushing. Accepted-but-uncommitted
+        batches are discarded, exactly what process death does to them;
+        rows committed after the last durable snapshot die with the
+        instance too — recovery restores that snapshot into a FRESH
+        service and fences the stale generation at admission. Safe to
+        call twice (provider unregistration is instance-guarded, thread
+        joins are idempotent)."""
         REGISTRY.unregister_provider("ingest", self.ingest_stats)
         self._stop.set()
         for s in self._shards:

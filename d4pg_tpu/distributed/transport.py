@@ -141,23 +141,49 @@ def _decode(payload: bytes) -> tuple[str, TransitionBatch, bool]:
 # forever; the extension is readable from the header alone, so sampled
 # frames are traceable at zero-decode admission time (a shed frame gets
 # its terminal span without ever parsing a column).
+#
+# Generation extension (the crash-recovery plane): bit 2 marks an
+# optional 4-byte u32 service-generation id AFTER the trace extension
+# (both optional, fixed order: aid, [trace], [gen], field table). A
+# sender learns the serving generation from the receiver's post-handshake
+# greeting and stamps it into every frame it ENCODES; a frame encoded
+# before a service crash and retried verbatim across the restart still
+# carries the pre-crash generation, which is exactly how the restarted
+# service fences ambiguous in-flight frames (ReplayService.add_payload)
+# instead of risking a double-commit against the restored snapshot.
+# Like the trace extension, it is header-only readable and absent bytes
+# keep old frames byte-identical forever.
 
 _RAW_PRE = struct.Struct("!BB")  # flags (bit0 count, bit1 trace), len(aid)
 _RAW_TRACE = struct.Struct("!Qd")  # trace id, birth timestamp
+_RAW_GEN = struct.Struct("!I")  # service generation id
 _F_COUNT = 0x01
 _F_TRACE = 0x02
+_F_GEN = 0x04
+
+# post-handshake receiver greeting: magic + current service generation.
+# Opt-in on BOTH sides (receiver configured with a generation source,
+# sender constructed with expect_generation=True) so the legacy wire
+# conversation is untouched byte for byte.
+_MAGIC_GEN = 0xD4FA
+_GEN_GREETING = struct.Struct("!HI")
 
 
 def encode_raw(actor_id: str, batch: TransitionBatch,
                count_env_steps: bool = True,
-               trace: tuple[int, float] | None = None) -> bytes:
+               trace: tuple[int, float] | None = None,
+               generation: int | None = None) -> bytes:
     aid = actor_id.encode()
     if len(aid) > 255:
         raise ValueError("actor_id longer than 255 bytes")
-    flags = (_F_COUNT if count_env_steps else 0) | (_F_TRACE if trace else 0)
+    flags = ((_F_COUNT if count_env_steps else 0)
+             | (_F_TRACE if trace else 0)
+             | (_F_GEN if generation is not None else 0))
     head = [_RAW_PRE.pack(flags, len(aid)), aid]
     if trace:
         head.append(_RAW_TRACE.pack(int(trace[0]), float(trace[1])))
+    if generation is not None:
+        head.append(_RAW_GEN.pack(int(generation) & 0xFFFFFFFF))
     head.append(struct.pack("!B", len(batch)))
     blobs = []
     for v in batch:
@@ -172,8 +198,9 @@ def encode_raw(actor_id: str, batch: TransitionBatch,
 
 def _raw_header(payload: bytes):
     """Parse the v2 header: (actor_id, count, [(dtype, shape)], data_off,
-    trace) — ``trace`` is ``(trace_id, birth_ts)`` when the frame carries
-    the tracing extension, else None."""
+    trace, generation) — ``trace`` is ``(trace_id, birth_ts)`` when the
+    frame carries the tracing extension, ``generation`` the u32 service
+    generation when it carries the recovery extension; else None."""
     flags, laid = _RAW_PRE.unpack_from(payload, 0)
     off = _RAW_PRE.size
     actor_id = payload[off:off + laid].decode()
@@ -182,6 +209,10 @@ def _raw_header(payload: bytes):
     if flags & _F_TRACE:
         trace = _RAW_TRACE.unpack_from(payload, off)
         off += _RAW_TRACE.size
+    generation = None
+    if flags & _F_GEN:
+        (generation,) = _RAW_GEN.unpack_from(payload, off)
+        off += _RAW_GEN.size
     (nf,) = struct.unpack_from("!B", payload, off)
     off += 1
     fields = []
@@ -193,30 +224,31 @@ def _raw_header(payload: bytes):
         shape = struct.unpack_from(f"!{ndim}I", payload, off)
         off += 4 * ndim
         fields.append((dtype, shape))
-    return actor_id, bool(flags & _F_COUNT), fields, off, trace
+    return actor_id, bool(flags & _F_COUNT), fields, off, trace, generation
 
 
 def raw_frame_meta(payload: bytes) -> tuple[str, int, bool]:
     """(actor_id, n_rows, count_env_steps) from the header alone — no
     column bytes touched. The admission-time accounting hook for the
     sharded receiver (shed rows are counted exactly without a decode)."""
-    actor_id, n, count, _trace = raw_frame_meta_ex(payload)
+    actor_id, n, count, _trace, _gen = raw_frame_meta_ex(payload)
     return actor_id, n, count
 
 
-def raw_frame_meta_ex(payload: bytes
-                      ) -> tuple[str, int, bool, tuple[int, float] | None]:
+def raw_frame_meta_ex(payload: bytes) -> tuple[
+        str, int, bool, tuple[int, float] | None, int | None]:
     """``raw_frame_meta`` plus the trace extension ``(trace_id,
-    birth_ts)`` (or None) — still header-only, so a sampled frame is
-    traceable (and shed-countable with a terminal span) before any
+    birth_ts)`` and the generation extension (each None when absent) —
+    still header-only, so a sampled frame is traceable (and a stale-
+    generation frame fence-able, with its terminal span) before any
     column byte is parsed."""
-    actor_id, count, fields, _, trace = _raw_header(payload)
+    actor_id, count, fields, _, trace, generation = _raw_header(payload)
     n = int(fields[0][1][0]) if fields and fields[0][1] else 0
-    return actor_id, n, count, trace
+    return actor_id, n, count, trace, generation
 
 
 def decode_raw(payload: bytes) -> tuple[str, TransitionBatch, bool]:
-    actor_id, count, fields, off, _trace = _raw_header(payload)
+    actor_id, count, fields, off, _trace, _gen = _raw_header(payload)
     if len(fields) != len(TransitionBatch._fields):
         raise ProtocolError(
             f"raw frame carries {len(fields)} fields, expected "
@@ -370,17 +402,38 @@ class TransitionSender(ReconnectingClient):
                  backoff_base: float = 0.2, backoff_max: float = 5.0,
                  backoff_seed: Optional[int] = None,
                  codec: str = "npz",
-                 trace_sample: float = 0.0):
+                 trace_sample: float = 0.0,
+                 expect_generation: bool = False,
+                 reconnect_jitter_s: float = 0.0):
         if codec not in CODECS:
             raise ValueError(f"unknown codec {codec!r}; one of {CODECS}")
         self.codec = codec
         self.actor_id = actor_id
+        # Crash-recovery plane: when the peer receiver serves a generation
+        # greeting, every (re)connect refreshes the id and raw frames are
+        # stamped with it at ENCODE time — a frame retried verbatim across
+        # a service restart keeps its pre-crash stamp and gets fenced.
+        self._expect_generation = bool(expect_generation)
+        self.generation = 0
         self._retry_timeout = retry_timeout
         self._max_retries = max_retries
         self._drop_on_timeout = drop_on_timeout
         self._backoff_base = backoff_base
         self._backoff_max = backoff_max
         self._backoff_rng = np.random.default_rng(backoff_seed)
+        # Reconnect-storm guard (crash-recovery plane): when > 0, the
+        # FIRST retry of a send episode sleeps an extra seeded uniform in
+        # [0, reconnect_jitter_s) before reconnecting. A service restart
+        # breaks every fleet lane at the same instant; the exponential
+        # backoff alone starts every lane at the same backoff_base, so
+        # the first wave of reconnects still lands as a storm. A separate
+        # rng keeps the pinned backoff stream bit-identical whether or
+        # not the guard is armed.
+        self._reconnect_jitter_s = float(reconnect_jitter_s)
+        self._storm_rng = np.random.default_rng(
+            None if backoff_seed is None else backoff_seed + 0x57a9)
+        self.storm_jitters = 0
+        self.storm_jitter_s: list[float] = []
         # Wire-to-grad tracing (obs/trace): sample this fraction of raw
         # frames and stamp them with a trace id + birth timestamp in the
         # v2 header extension. Seeded alongside the backoff rng so a
@@ -395,6 +448,31 @@ class TransitionSender(ReconnectingClient):
         self.frames_dropped = 0
         self.retries = 0
         super().__init__(host, port, connect_timeout, secret)
+
+    def _connect(self) -> None:
+        super()._connect()
+        if not self._expect_generation:
+            return
+        # the greeting rides the fresh socket before any frame: a missing
+        # or malformed greeting is a config fault (peer not serving
+        # generations), surfaced as ProtocolError — reconnecting can't heal
+        sock = self._sock
+        sock.settimeout(self._connect_timeout)
+        try:
+            raw = _recv_exact(sock, _GEN_GREETING.size)
+            if raw is None:
+                raise ConnectionError("peer closed before generation greeting")
+            magic, gen = _GEN_GREETING.unpack(raw)
+            if magic != _MAGIC_GEN:
+                raise ProtocolError(
+                    f"expected generation greeting, got magic {magic:#x}")
+            self.generation = int(gen)
+        except (OSError, ConnectionError):
+            self._drop_sock()
+            raise
+        finally:
+            if self._sock is not None:
+                self._sock.settimeout(None)
 
     def send(self, batch: TransitionBatch, count_env_steps: bool = True,
              timeout: float | None = None) -> bool:
@@ -413,7 +491,10 @@ class TransitionSender(ReconnectingClient):
                 trace = (new_trace_id(self._trace_salt), time.monotonic())
                 self.frames_traced += 1
             data = encode_raw(self.actor_id, batch, count_env_steps,
-                              trace=trace)
+                              trace=trace,
+                              generation=(self.generation
+                                          if self._expect_generation
+                                          else None))
         else:
             data = _encode(self.actor_id, batch, count_env_steps)
         with self._lock:
@@ -449,9 +530,19 @@ class TransitionSender(ReconnectingClient):
                 # inside a dying peer's teardown window (a just-closed
                 # listener can keep completing handshakes into its backlog
                 # for a beat — connecting there loses the frame silently).
+                extra = 0.0
+                if attempts == 0 and self._reconnect_jitter_s > 0.0:
+                    # storm guard: only the FIRST attempt of an episode
+                    # pays the spread — later attempts are already
+                    # de-synchronized by the exponential schedule
+                    extra = (float(self._storm_rng.random())
+                             * self._reconnect_jitter_s)
+                    self.storm_jitters += 1
+                    self.storm_jitter_s.append(extra)
                 jitter = 1.0 + 0.5 * float(self._backoff_rng.random())
                 self._stop.wait(
-                    min(backoff * jitter, max(0.0, deadline - now)))
+                    min(backoff * jitter + extra,
+                        max(0.0, deadline - now)))
                 self._check_open()
                 backoff = min(backoff * 2, self._backoff_max)
                 attempts += 1
@@ -507,14 +598,18 @@ class CoalescingSender(TransitionSender):
                  backoff_base: float = 0.2, backoff_max: float = 5.0,
                  backoff_seed: Optional[int] = None,
                  codec: str = "npz",
-                 trace_sample: float = 0.0):
+                 trace_sample: float = 0.0,
+                 expect_generation: bool = False,
+                 reconnect_jitter_s: float = 0.0):
         super().__init__(host, port, actor_id,
                          connect_timeout=connect_timeout, secret=secret,
                          retry_timeout=retry_timeout, max_retries=max_retries,
                          drop_on_timeout=drop_on_timeout,
                          backoff_base=backoff_base, backoff_max=backoff_max,
                          backoff_seed=backoff_seed, codec=codec,
-                         trace_sample=trace_sample)
+                         trace_sample=trace_sample,
+                         expect_generation=expect_generation,
+                         reconnect_jitter_s=reconnect_jitter_s)
         self._min_block = max(1, int(min_block))
         self._max_block = max(self._min_block, int(max_block))
         self._target = self._min_block
@@ -657,10 +752,16 @@ class TransitionReceiver(ConnRegistry):
         max_payload: int = MAX_PAYLOAD,
         num_shards: int = 1,
         on_payload: Optional[Callable[[bytes, int, str], object]] = None,
+        generation: int | Callable[[], int] | None = None,
     ):
         super().__init__()
         self._on_batch = on_batch
         self._on_payload = on_payload
+        # crash-recovery plane: when set (int or zero-arg callable), every
+        # accepted connection is greeted with the CURRENT service
+        # generation right after the auth handshake, so reconnecting
+        # senders re-stamp their frames with the post-restart id
+        self._generation = generation
         self._secret = secret
         self._max_payload = int(max_payload)
         self.num_shards = max(1, int(num_shards))
@@ -732,6 +833,11 @@ class TransitionReceiver(ConnRegistry):
             with conn:
                 if not server_handshake(conn, self._secret):
                     return  # unauthenticated peer; drop before reading frames
+                if self._generation is not None:
+                    gen = (self._generation() if callable(self._generation)
+                           else self._generation)
+                    conn.sendall(_GEN_GREETING.pack(
+                        _MAGIC_GEN, int(gen) & 0xFFFFFFFF))
                 while not self._stop.is_set():
                     header = _recv_exact(conn, _HEADER.size)
                     if header is None:

@@ -47,6 +47,22 @@ class ChaosConfig:
     # for ``receiver_stall_s`` (0 for either disables stalls).
     receiver_stall_s: float = 0.0
     stall_every_s: float = 0.0
+    # Learner-kill chaos (the crash-recovery plane's fault): the harness
+    # SIGKILL-equivalently tears down the WHOLE replay service (receiver
+    # + ingest + buffer) ``service_kill_count`` times, roughly every
+    # ``service_kill_every_s`` of harness time (seeded jitter spreads the
+    # kill instants so they never phase-lock with the stall script), and
+    # a supervisor restarts it from the last durable snapshot — bounded
+    # by ``service_restart_max`` attempts with ``service_restart_backoff_s``
+    # exponential backoff between them.
+    service_kill_every_s: float = 0.0
+    service_kill_count: int = 0
+    service_restart_max: int = 3
+    service_restart_backoff_s: float = 0.25
+    # Snapshot cadence for the supervisor (the "checkpoint interval"):
+    # rows committed after the latest snapshot die with the service —
+    # the declared crash loss the recovery report accounts for.
+    service_snapshot_every_s: float = 1.0
     seed: int = 0
 
     def __post_init__(self):
@@ -56,11 +72,20 @@ class ChaosConfig:
                 raise ValueError(f"{name}={p} outside [0, 1]")
         if self.delay_max_s < self.delay_min_s:
             raise ValueError("delay_max_s < delay_min_s")
+        if self.service_kill_count < 0:
+            raise ValueError("service_kill_count must be >= 0")
+        if self.service_kill_count > 0 and self.service_kill_every_s <= 0:
+            raise ValueError(
+                "service_kill_count > 0 needs service_kill_every_s > 0")
 
     def enabled(self) -> bool:
         return (self.drop_prob > 0 or self.delay_prob > 0
                 or self.crash_prob > 0
-                or (self.receiver_stall_s > 0 and self.stall_every_s > 0))
+                or (self.receiver_stall_s > 0 and self.stall_every_s > 0)
+                or self.service_chaos_enabled())
+
+    def service_chaos_enabled(self) -> bool:
+        return self.service_kill_count > 0 and self.service_kill_every_s > 0
 
 
 class ChaosEvent(NamedTuple):
@@ -124,6 +149,29 @@ class ChaosPolicy:
         while t < horizon_s:
             out.append((t, cfg.receiver_stall_s))
             t += cfg.stall_every_s + cfg.receiver_stall_s
+        return out
+
+    def service_kill_schedule(self, horizon_s: float) -> list[float]:
+        """Seeded kill instants (offsets into harness time) for the
+        learner-kill supervisor: ``service_kill_count`` kills, nominally
+        ``service_kill_every_s`` apart, each jittered by a seeded uniform
+        in ±25% of the interval so kills never phase-lock with the stall
+        script (a kill landing INSIDE a stall is a legal — and nastier —
+        schedule, it just should not be the only one a seed can produce).
+        Deterministic from ``ChaosConfig.seed`` alone, like every other
+        fault stream; kills past ``horizon_s`` are clipped."""
+        cfg = self.config
+        if not cfg.service_chaos_enabled():
+            return []
+        rng = np.random.default_rng(
+            np.random.SeedSequence(cfg.seed, spawn_key=(0x5E11,)))
+        out = []
+        for i in range(cfg.service_kill_count):
+            base = (i + 1) * cfg.service_kill_every_s
+            jit = (rng.random() - 0.5) * 0.5 * cfg.service_kill_every_s
+            t = max(0.1, base + jit)
+            if t < horizon_s:
+                out.append(round(float(t), 3))
         return out
 
 

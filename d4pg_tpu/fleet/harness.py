@@ -107,6 +107,11 @@ class FleetConfig:
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
     template_seed: int = 0
     connect_stagger_s: float = 0.002  # per-lane offset on the connect storm
+    # Reconnect-storm guard (service_chaos runs): seeded per-lane upward
+    # jitter, uniform in [0, reconnect_jitter_s), on the FIRST retry
+    # after a lane loses its connection — a restarted service meets a
+    # spread of reconnects instead of n_actors simultaneous handshakes.
+    reconnect_jitter_s: float = 0.25
     # 'actor' mode knobs: the env each real actor runs and its pool width
     actor_env: str = "point"
     actor_num_envs: int = 2
@@ -118,6 +123,18 @@ class FleetConfig:
             raise ValueError(f"unknown codec {self.codec!r}")
         if self.ingest_shards < 1:
             raise ValueError("ingest_shards must be >= 1")
+        if self.chaos.service_chaos_enabled():
+            # generation fencing rides the v2 raw header: npz frames
+            # carry no generation, so a restarted service could not tell
+            # a pre-crash retry from a fresh row — a silent duplicate
+            # instead of a declared fence. Refuse the configuration.
+            if self.resolved_codec() != "raw":
+                raise ValueError(
+                    "service_chaos needs codec='raw' (generation fencing "
+                    "is a v2 raw-header extension)")
+            if self.mode != "thread":
+                raise ValueError(
+                    "service_chaos supervisor runs in thread mode only")
 
     def resolved_codec(self) -> str:
         if self.codec != "auto":
@@ -213,13 +230,15 @@ class FleetHarness:
             print(f"flight-recorder dump failed: {e}", flush=True)
             return None
 
-    def _start_consumer(self, service: ReplayService,
+    def _start_consumer(self, service_ref,
                         stop: threading.Event) -> threading.Thread | None:
         """The consumer lane: concurrently samples the service like a
         learner would and marks grad consumption for committed traces.
         Only runs when tracing is armed — it changes the plane's
         concurrency profile (sample() under the buffer lock vs the
-        commit thread), which untraced runs must not silently gain."""
+        commit thread), which untraced runs must not silently gain.
+        ``service_ref`` is a zero-arg callable: under service_chaos the
+        live service is swapped out by the supervisor mid-run."""
         cfg = self.config
         if cfg.trace_sample <= 0:
             return None
@@ -228,11 +247,12 @@ class FleetHarness:
 
         def consume():
             while not stop.is_set():
+                service = service_ref()
                 if len(service) >= batch:
                     try:
                         service.sample(batch)
-                    except ValueError:
-                        pass  # raced an empty/shrinking buffer: benign
+                    except (ValueError, RuntimeError):
+                        pass  # raced an empty buffer or a dying service
                     obs_trace.RECORDER.mark_grad()
                 stop.wait(period)
 
@@ -258,7 +278,8 @@ class FleetHarness:
 
     # -- shared receiver construction --------------------------------------
     def _make_service(self, obs_dim: int | None = None,
-                      act_dim: int | None = None) -> ReplayService:
+                      act_dim: int | None = None,
+                      generation: int = 0) -> ReplayService:
         cfg = self.config
         return ReplayService(
             ReplayBuffer(cfg.capacity,
@@ -268,15 +289,22 @@ class FleetHarness:
             heartbeat_timeout=cfg.heartbeat_timeout,
             shed_watermark=cfg.shed_watermark,
             num_ingest_shards=cfg.ingest_shards,
+            generation=generation,
         )
 
     def _make_receiver(self, service: ReplayService,
-                       gate: StallGate | None = None) -> TransitionReceiver:
+                       gate: StallGate | None = None,
+                       port: int = 0,
+                       generation=None) -> TransitionReceiver:
         """K>1 (or K=1 on the raw codec): shard-aware receiver forwarding
         UNDECODED payloads so decode runs on the owning ingest shard's
         worker — the path that reads the v2 header's trace extension at
         admission. K=1 on npz: the legacy decode-in-connection-thread
-        path, bit-compatible with PR 3."""
+        path, bit-compatible with PR 3. ``port``/``generation``: the
+        service_chaos supervisor rebinds a restarted receiver on the SAME
+        port (SO_REUSEADDR — the fleet's retry path reconnects to the
+        address it already has) and arms the generation greeting so
+        pre-crash frames fence at admission."""
         cfg = self.config
         if cfg.ingest_shards > 1 or cfg.resolved_codec() == "raw":
             def on_payload(payload, shard, codec):
@@ -287,8 +315,8 @@ class FleetHarness:
             return TransitionReceiver(
                 lambda b, aid, count: service.add(
                     b, actor_id=aid, block=False, count_env_steps=count),
-                host="127.0.0.1", num_shards=cfg.ingest_shards,
-                on_payload=on_payload)
+                host="127.0.0.1", port=port, num_shards=cfg.ingest_shards,
+                on_payload=on_payload, generation=generation)
 
         def on_batch(batch, actor_id, count):
             if gate is not None:
@@ -296,7 +324,8 @@ class FleetHarness:
             service.add(batch, actor_id=actor_id, block=False,
                         count_env_steps=count)
 
-        return TransitionReceiver(on_batch, host="127.0.0.1")
+        return TransitionReceiver(on_batch, host="127.0.0.1", port=port,
+                                  generation=generation)
 
     # -- thread mode -------------------------------------------------------
     def run(self) -> dict:
@@ -314,17 +343,25 @@ class FleetHarness:
 
     def _run_threads(self) -> dict:
         cfg = self.config
+        svc_chaos = cfg.chaos.service_chaos_enabled()
         self._arm_lock_sentinels()
         self._arm_obs()
-        service = self._make_service()
+        # Mutable holder: under service_chaos the supervisor SIGKILLs the
+        # service and swaps a restored replacement in mid-run; every
+        # long-lived thread (monitor, consumer, teardown) reads the live
+        # instance through the holder instead of a stale binding.
+        holder: dict = {"svc": self._make_service()}
         gate = StallGate()
-        receiver = self._make_receiver(service, gate)
+        gen_ref = (lambda: holder["svc"].generation) if svc_chaos else None
+        holder["recv"] = self._make_receiver(holder["svc"], gate,
+                                             generation=gen_ref)
+        port = holder["recv"].port
         template = synthetic_block(cfg.block_rows, cfg.obs_dim, cfg.act_dim,
                                    seed=cfg.template_seed)
         stop = threading.Event()
         lanes = [
             ThrottledSender(
-                i, f"fleet-{i}", "127.0.0.1", receiver.port, template,
+                i, f"fleet-{i}", "127.0.0.1", port, template,
                 self.policy.actor_stream(i, f"fleet-{i}"),
                 rows_per_sec=cfg.rows_per_sec,
                 send_timeout=cfg.send_timeout, max_retries=cfg.max_retries,
@@ -332,6 +369,9 @@ class FleetHarness:
                 connect_stagger_s=i * cfg.connect_stagger_s,
                 codec=cfg.resolved_codec(),
                 trace_sample=cfg.trace_sample,
+                expect_generation=svc_chaos,
+                reconnect_jitter_s=(cfg.reconnect_jitter_s if svc_chaos
+                                    else 0.0),
             )
             for i in range(cfg.n_actors)
         ]
@@ -349,7 +389,7 @@ class FleetHarness:
             stalls = list(self.policy.stall_schedule(horizon))
             t0 = time.monotonic()
             while not monitor_stop.is_set():
-                service.evict_dead()
+                holder["svc"].evict_dead()
                 now = time.monotonic() - t0
                 if stalls and now >= stalls[0][0]:
                     _, dur = stalls.pop(0)
@@ -361,13 +401,27 @@ class FleetHarness:
 
         monitor_thread = threading.Thread(target=monitor, daemon=True)
 
+        recovery = None
+        supervisor_thread = None
+        if svc_chaos:
+            recovery = {"kills": 0, "restarts": 0, "failed_restarts": 0,
+                        "mttr_s": [], "rows_lost_to_crash": 0,
+                        "snapshots": 0, "frames_fenced": 0, "rows_fenced": 0}
+            supervisor_thread = threading.Thread(
+                target=self._supervise, daemon=True,
+                name="fleet-supervisor",
+                args=(holder, gate, gen_ref, monitor_stop, recovery))
+
         t_start = time.monotonic()
-        steps0 = service.env_steps
+        steps0 = holder["svc"].env_steps
         for t in threads:
             t.start()
         monitor_thread.start()
+        if supervisor_thread is not None:
+            supervisor_thread.start()
         consumer_stop = threading.Event()
-        consumer_thread = self._start_consumer(service, consumer_stop)
+        consumer_thread = self._start_consumer(lambda: holder["svc"],
+                                               consumer_stop)
 
         deadlocks = 0
         if cfg.max_ticks is not None:
@@ -389,6 +443,9 @@ class FleetHarness:
         gate.resume()  # never leave the drain gated during teardown
         monitor_stop.set()
         monitor_thread.join(timeout=5.0)
+        if supervisor_thread is not None:
+            supervisor_thread.join(timeout=15.0)
+        service, receiver = holder["svc"], holder["recv"]
         _quiesce(service)
         receiver.close()
         service.flush(timeout=10.0)
@@ -399,12 +456,118 @@ class FleetHarness:
         stats = service.ingest_stats()
         if stats["pending"] > 0 or not service._drain_thread.is_alive():
             deadlocks += 1  # drain wedged with accepted batches in flight
+        if recovery is not None:
+            # the final incarnation's fence counters (killed incarnations
+            # were absorbed at their kill instants)
+            recovery["frames_fenced"] += stats.get("fenced_frames", 0)
+            recovery["rows_fenced"] += stats.get("fenced_rows", 0)
+            recovery["final_generation"] = service.generation
         service.close()
 
         return self._report(lanes=[lane.summary() for lane in lanes],
                             rows_inserted=rows_inserted, dt=dt,
                             service_stats=stats, deadlocks=deadlocks,
-                            stalls=gate.stalls, locks=self._lock_report())
+                            stalls=gate.stalls, locks=self._lock_report(),
+                            recovery=recovery)
+
+    # -- the learner-kill supervisor ---------------------------------------
+    def _supervise(self, holder: dict, gate: StallGate, gen_ref,
+                   stop_ev: threading.Event, recovery: dict) -> None:
+        """Periodic durable snapshots + the seeded kill script. Between
+        kills the supervisor snapshots the live service every
+        ``service_snapshot_every_s`` (the checkpoint cadence); at each
+        kill instant it tears the service down ABRUPTLY and restarts it
+        from the latest snapshot — rows committed after that cut are the
+        declared crash loss, frames from the dead generation fence at
+        admission, and MTTR is kill → first row committed by the
+        restored incarnation."""
+        cfg = self.config
+        ch = cfg.chaos
+        horizon = cfg.duration_s if cfg.max_ticks is None else 3600.0
+        kills = list(self.policy.service_kill_schedule(horizon))
+        t0 = time.monotonic()
+        snap = holder["svc"].snapshot(quiesce_timeout=0.25)
+        recovery["snapshots"] += 1
+        next_snap = time.monotonic() + ch.service_snapshot_every_s
+        while not stop_ev.is_set():
+            now = time.monotonic() - t0
+            if kills and now >= kills[0]:
+                kills.pop(0)
+                self._kill_and_restart(holder, gate, gen_ref, stop_ev,
+                                       recovery, snap)
+                next_snap = time.monotonic() + ch.service_snapshot_every_s
+                continue
+            if time.monotonic() >= next_snap:
+                try:
+                    snap = holder["svc"].snapshot(quiesce_timeout=0.25)
+                    recovery["snapshots"] += 1
+                except (RuntimeError, ValueError) as e:
+                    obs_flight.record_event("snapshot_failed", err=str(e))
+                next_snap = time.monotonic() + ch.service_snapshot_every_s
+            stop_ev.wait(0.02)
+
+    def _kill_and_restart(self, holder: dict, gate: StallGate, gen_ref,
+                          stop_ev: threading.Event, recovery: dict,
+                          snap: dict) -> None:
+        cfg = self.config
+        ch = cfg.chaos
+        svc, recv = holder["svc"], holder["recv"]
+        port = recv.port
+        # the replacement's FLOOR generation: constructor-seeded above the
+        # dead incarnation so fencing stays correct even when two kills
+        # land between periodic snapshots (restore alone would rewind the
+        # id to snapshot-time + 1, un-fencing the first incarnation)
+        next_gen = svc.generation + 1
+        t_kill = time.monotonic()
+        stats = svc.ingest_stats()
+        rows_at_kill = svc.env_steps
+        obs_flight.record_event("service_kill", generation=svc.generation,
+                                env_steps=rows_at_kill)
+        recv.close()
+        svc.kill()  # abrupt: accepted-but-uncommitted batches die here
+        recovery["kills"] += 1
+        recovery["frames_fenced"] += stats.get("fenced_frames", 0)
+        recovery["rows_fenced"] += stats.get("fenced_rows", 0)
+        recovery["rows_lost_to_crash"] += max(
+            0, rows_at_kill - int(snap.get("env_steps", 0)))
+        backoff = ch.service_restart_backoff_s
+        for attempt in range(max(1, ch.service_restart_max)):
+            stop_ev.wait(backoff)
+            backoff = min(backoff * 2.0, 5.0)
+            new = None
+            try:
+                new = self._make_service(generation=next_gen)
+                new.restore(snap)
+                # service first, THEN the receiver: a sender racing the
+                # swap must never be greeted with the dead generation
+                holder["svc"] = new
+                holder["recv"] = self._make_receiver(new, gate, port=port,
+                                                     generation=gen_ref)
+            except OSError as e:
+                obs_flight.record_event("service_restart_failed",
+                                        attempt=attempt, err=str(e))
+                if new is not None:
+                    new.kill()
+                continue
+            recovery["restarts"] += 1
+            obs_flight.record_event("service_restart",
+                                    generation=new.generation,
+                                    attempt=attempt)
+            # MTTR: kill instant -> first row COMMITTED by the restored
+            # incarnation (not first reconnect — committed rows are what
+            # the learner can train on again)
+            restored_steps = new.env_steps
+            deadline = time.monotonic() + 30.0
+            while not stop_ev.is_set() and time.monotonic() < deadline:
+                if new.env_steps > restored_steps:
+                    recovery["mttr_s"].append(
+                        round(time.monotonic() - t_kill, 4))
+                    break
+                stop_ev.wait(0.005)
+            return
+        recovery["failed_restarts"] += 1
+        obs_flight.record_event("service_restart_exhausted",
+                                attempts=ch.service_restart_max)
 
     # -- process mode ------------------------------------------------------
     def _run_processes(self) -> dict:
@@ -446,7 +609,7 @@ class FleetHarness:
         t_start = time.monotonic()
         steps0 = service.env_steps
         consumer_stop = threading.Event()
-        consumer_thread = self._start_consumer(service, consumer_stop)
+        consumer_thread = self._start_consumer(lambda: service, consumer_stop)
         summaries, deadlocks = [], 0
         for _ in procs:
             try:
@@ -522,7 +685,7 @@ class FleetHarness:
         t_start = time.monotonic()
         steps0 = service.env_steps
         consumer_stop = threading.Event()
-        consumer_thread = self._start_consumer(service, consumer_stop)
+        consumer_thread = self._start_consumer(lambda: service, consumer_stop)
         summaries, deadlocks = [], 0
         # real actors pay a jax+env import per process: generous budget
         budget = 120.0 + ticks * cfg.actor_num_envs * 0.05
@@ -573,7 +736,8 @@ class FleetHarness:
     # -- artifact ----------------------------------------------------------
     def _report(self, lanes: list[dict], rows_inserted: int, dt: float,
                 service_stats: dict, deadlocks: int, stalls: int,
-                locks: dict | None = None) -> dict:
+                locks: dict | None = None,
+                recovery: dict | None = None) -> dict:
         cfg = self.config
         latencies = [v for lane in lanes for v in lane["latencies_ms"]]
         lane_recovery = [v for lane in lanes for v in lane["recovery_s"]]
@@ -641,6 +805,36 @@ class FleetHarness:
             "ticks": sum(lane["ticks"] for lane in lanes),
             "chaos": dataclasses.asdict(cfg.chaos),
             "seed": cfg.chaos.seed,
+            # crash-recovery plane (None unless service_chaos ran): the
+            # supervisor's ledger + the reconnect-storm spread proof
+            "service_chaos": self._recovery_block(lanes, recovery),
             "chaos_log": sorted(
                 ev for lane in lanes for ev in lane["chaos_log"]),
+        }
+
+    @staticmethod
+    def _recovery_block(lanes: list[dict],
+                        recovery: dict | None) -> dict | None:
+        if recovery is None:
+            return None
+        jitters = [v for lane in lanes
+                   for v in lane.get("storm_jitter_s", [])]
+        return {
+            "kills": recovery["kills"],
+            "restarts": recovery["restarts"],
+            "failed_restarts": recovery["failed_restarts"],
+            "mttr_s": _recovery_stats(recovery["mttr_s"]),
+            "snapshots": recovery["snapshots"],
+            "rows_lost_to_crash": recovery["rows_lost_to_crash"],
+            "frames_fenced": recovery["frames_fenced"],
+            "rows_fenced": recovery["rows_fenced"],
+            "final_generation": recovery.get("final_generation"),
+            # the satellite's spread proof: distinct seeded jitters drawn
+            # by distinct lanes on their first post-break retry — a storm
+            # that arrived as one thundering herd would show distinct <= 1
+            "reconnect_storm": {
+                "jitters": len(jitters),
+                "distinct": len({round(v, 6) for v in jitters}),
+                "spread_ms": _percentiles([1e3 * v for v in jitters]),
+            },
         }

@@ -83,6 +83,8 @@ class ThrottledSender:
         connect_stagger_s: float = 0.0,
         codec: str = "npz",
         trace_sample: float = 0.0,
+        expect_generation: bool = False,
+        reconnect_jitter_s: float = 0.0,
     ):
         self.actor_index = actor_index
         self.actor_id = actor_id
@@ -99,7 +101,15 @@ class ThrottledSender:
         self._connect_stagger_s = connect_stagger_s
         self._codec = codec
         self._trace_sample = float(trace_sample)
+        # crash-recovery plane: read the receiver's generation greeting
+        # (service_chaos runs — the receiver must be greeting-armed) and
+        # spread the post-service-restart reconnect storm with a seeded
+        # per-actor upward jitter on the first retry of an episode
+        self._expect_generation = bool(expect_generation)
+        self._reconnect_jitter_s = float(reconnect_jitter_s)
         # counters (absorbed across crash-replaced sender instances)
+        self.storm_jitters = 0
+        self.storm_jitter_s: list[float] = []
         self.frames_traced = 0
         self.ticks = 0
         self.rows_attempted = 0
@@ -127,6 +137,8 @@ class ThrottledSender:
             backoff_seed=self.chaos.config.seed * 100_003 + self.actor_index,
             codec=self._codec,
             trace_sample=self._trace_sample,
+            expect_generation=self._expect_generation,
+            reconnect_jitter_s=self._reconnect_jitter_s,
         )
 
     def _absorb(self, sender: CoalescingSender) -> None:
@@ -134,6 +146,8 @@ class ThrottledSender:
         self.rows_dropped_backpressure += sender.dropped_rows
         self.retries += sender.retries
         self.frames_traced += sender.frames_traced
+        self.storm_jitters += sender.storm_jitters
+        self.storm_jitter_s.extend(sender.storm_jitter_s)
 
     def _sleep(self, seconds: float) -> None:
         if seconds > 0:
@@ -220,6 +234,8 @@ class ThrottledSender:
             "crashes": self.crashes,
             "failed_restarts": self.failed_restarts,
             "frames_traced": self.frames_traced,
+            "storm_jitters": self.storm_jitters,
+            "storm_jitter_s": list(self.storm_jitter_s),
             "recovery_s": list(self.recovery_s),
             "latencies_ms": list(self.latencies_ms),
             "chaos_log": [tuple(ev) for ev in self.chaos.log],

@@ -41,6 +41,25 @@ def default_chaos(seed: int = 0) -> ChaosConfig:
     )
 
 
+def default_service_chaos(seed: int = 0,
+                          duration_s: float = 10.0) -> ChaosConfig:
+    """The recovery run's fault mix: the FULL standard set (drops,
+    stragglers, actor crashes, receiver stalls) PLUS the learner-kill
+    script — two service kills inside ``duration_s`` (the acceptance
+    bar: the service dies >= 2x mid-run), a 1 s snapshot cadence and a
+    bounded-backoff supervisor."""
+    import dataclasses as _dc
+
+    return _dc.replace(
+        default_chaos(seed),
+        service_kill_every_s=duration_s / 3.5,
+        service_kill_count=2,
+        service_snapshot_every_s=1.0,
+        service_restart_max=3,
+        service_restart_backoff_s=0.25,
+    )
+
+
 def run_sweep(
     ns=SWEEP_NS,
     duration_s: float = 10.0,
@@ -156,6 +175,133 @@ def shard_sweep(
             }
             for r in rows
         ],
+    }
+
+
+def recovery_probe(seed: int = 0, blocks: int = 48, block_rows: int = 32,
+                   obs_dim: int = 12, act_dim: int = 3,
+                   cut: int = 24, lost: int = 4) -> dict:
+    """The post-restore bitwise oracle: kill-and-restore must equal an
+    uninterrupted run, modulo the declared losses.
+
+    Deterministic, no sockets: incarnation A ingests blocks ``[0, cut)``,
+    snapshots, ingests ``lost`` more (the in-flight rows a real crash
+    forgets) and is SIGKILL-equivalently torn down. Incarnation B is
+    built at the next generation, restores the snapshot, and ingests the
+    remainder ``[cut+lost, blocks)``. The oracle C ingests exactly the
+    surviving blocks in one uninterrupted life. B's buffer must equal
+    C's BITWISE (columns, PER tree, write head) — recovery is
+    exactly-once w.r.t. committed rows, with the ``lost`` blocks
+    appearing ONLY in the declared-loss ledger."""
+    import numpy as np
+
+    from d4pg_tpu.distributed.replay_service import ReplayService
+    from d4pg_tpu.fleet.sender import synthetic_block
+    from d4pg_tpu.replay.uniform import ReplayBuffer
+
+    capacity = blocks * block_rows  # no wraparound: the cut stays legible
+
+    def mk(generation: int = 0) -> ReplayService:
+        return ReplayService(ReplayBuffer(capacity, obs_dim, act_dim),
+                             generation=generation)
+
+    def block(i: int):
+        return synthetic_block(block_rows, obs_dim, act_dim,
+                               seed=seed * 100_003 + i)
+
+    a = mk()
+    for i in range(cut):
+        a.add(block(i), actor_id="probe")
+    a.flush(timeout=10.0)
+    snap = a.snapshot()
+    for i in range(cut, cut + lost):
+        a.add(block(i), actor_id="probe")
+    a.flush(timeout=10.0)
+    rows_lost = a.env_steps - int(snap["env_steps"])
+    a.kill()  # abrupt: the post-snapshot rows die undeclared-nowhere-else
+
+    b = mk(generation=int(snap["generation"]) + 1)
+    b.restore(snap)
+    survivors = list(range(cut)) + list(range(cut + lost, blocks))
+    for i in range(cut + lost, blocks):
+        b.add(block(i), actor_id="probe")
+    b.flush(timeout=10.0)
+    b_state = b.replay_state()
+    b_rows = b.env_steps
+    b.close()
+
+    c = mk()
+    for i in survivors:
+        c.add(block(i), actor_id="probe")
+    c.flush(timeout=10.0)
+    c_state = c.replay_state()
+    c.close()
+
+    def bitwise(x, y) -> bool:
+        if isinstance(x, dict):
+            return (isinstance(y, dict) and x.keys() == y.keys()
+                    and all(bitwise(x[k], y[k]) for k in x))
+        if isinstance(x, (list, tuple)):
+            return (isinstance(y, (list, tuple)) and len(x) == len(y)
+                    and all(bitwise(a_, b_) for a_, b_ in zip(x, y)))
+        xa, ya = np.asarray(x), np.asarray(y)
+        return xa.dtype == ya.dtype and bool(np.array_equal(xa, ya))
+
+    return {
+        "oracle_bitwise_equal": bitwise(b_state, c_state),
+        "rows_lost_declared": int(rows_lost),
+        "rows_compared": int(b_rows),
+        "blocks": int(blocks),
+        "blocks_lost": int(lost),
+        "seed": int(seed),
+    }
+
+
+def run_recovery(
+    n_actors: int = 64,
+    duration_s: float = 10.0,
+    ingest_shards: int = 2,
+    rows_per_sec: float = 30.0,
+    seed: int = 0,
+    chaos: ChaosConfig | None = None,
+    **overrides,
+) -> dict:
+    """The bench_fleet recovery block: one service_chaos run (full fault
+    set + the seeded learner-kill script) flattened to the recovery
+    headline numbers, plus the deterministic bitwise oracle probe."""
+    chaos = (default_service_chaos(seed, duration_s) if chaos is None
+             else chaos)
+    cfg = FleetConfig(n_actors=int(n_actors), duration_s=duration_s,
+                      ingest_shards=int(ingest_shards),
+                      rows_per_sec=rows_per_sec, codec="raw", chaos=chaos,
+                      **overrides)
+    result = FleetHarness(cfg).run()
+    result.pop("chaos_log", None)
+    sc = result.get("service_chaos") or {}
+    locks = result.get("locks")
+    return {
+        "metric": "fleet_recovery",
+        "schema": 1,
+        "n_actors": int(n_actors),
+        "ingest_shards": int(ingest_shards),
+        "duration_s": result["duration_s"],
+        "kills": sc.get("kills", 0),
+        "restarts": sc.get("restarts", 0),
+        "failed_restarts": sc.get("failed_restarts", 0),
+        "mttr_s": sc.get("mttr_s"),
+        "snapshots": sc.get("snapshots", 0),
+        "rows_fenced": sc.get("rows_fenced", 0),
+        "frames_fenced": sc.get("frames_fenced", 0),
+        "rows_lost_to_crash": sc.get("rows_lost_to_crash", 0),
+        "final_generation": sc.get("final_generation"),
+        "reconnect_storm": sc.get("reconnect_storm"),
+        "rows_inserted": result["rows_inserted"],
+        "deadlocks": result["deadlocks"],
+        "hierarchy_violations": (locks["hierarchy_violations"]
+                                 if locks else None),
+        "oracle": recovery_probe(seed=seed),
+        "chaos": dataclasses.asdict(chaos),
+        "seed": int(seed),
     }
 
 

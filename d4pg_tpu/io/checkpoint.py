@@ -11,6 +11,9 @@ exactly) plus user metadata (env steps, episode count).
 from __future__ import annotations
 
 import os
+import pickle
+import struct
+import zlib
 from typing import Any
 
 import jax
@@ -18,6 +21,85 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from d4pg_tpu.learner.state import D4PGState
+
+# -- replay sidecar (crash-recovery plane) ---------------------------------
+#
+# The ReplayService snapshot travels NEXT TO the orbax checkpoint, not
+# inside it (the `extra` payload couples replay availability to the orbax
+# retention window — see train._save_host_replay's history). The sidecar
+# is a pickle framed with a magic + CRC32 footer so a torn write or bit
+# rot is REJECTED with a clean error instead of feeding a half-snapshot
+# into load_state_dict (where it would surface as a shape error deep in
+# the buffer, or worse, not at all).
+
+_SIDECAR_MAGIC = b"D4RS"  # D4PG Replay Sidecar
+_SIDECAR_HEAD = struct.Struct("!4sBI")  # magic, version, crc32(payload)
+SIDECAR_VERSION = 1
+
+
+class SnapshotCorruptError(RuntimeError):
+    """A replay sidecar whose bytes fail the integrity check (bad magic,
+    unknown version, CRC mismatch, or an unpicklable body). Callers treat
+    it like a missing sidecar — learner-only resume — but LOUDLY: silent
+    acceptance of a torn snapshot would poison the restored buffer."""
+
+
+def replay_sidecar_path(run_dir: str, process_index: int) -> str:
+    return os.path.join(run_dir, f"replay_p{process_index}.pkl")
+
+
+def save_replay_sidecar(run_dir: str, process_index: int, step: int,
+                        snap: dict) -> str:
+    """Atomically persist one host's replay snapshot, stamped with the
+    learner step of its cut. Write-then-rename (a crash mid-save leaves
+    the previous sidecar intact) with the CRC frame described above.
+    Returns the sidecar path."""
+    payload = pickle.dumps({"step": int(step), "snap": snap},
+                           protocol=pickle.HIGHEST_PROTOCOL)
+    head = _SIDECAR_HEAD.pack(_SIDECAR_MAGIC, SIDECAR_VERSION,
+                              zlib.crc32(payload) & 0xFFFFFFFF)
+    path = replay_sidecar_path(run_dir, process_index)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(head + payload)
+    os.replace(tmp, path)
+    return path
+
+
+def load_replay_sidecar(run_dir: str,
+                        process_index: int) -> tuple[dict, int] | None:
+    """Read one host's replay sidecar: ``(snap, snap_step)``, or None
+    when the file does not exist (the learner-only resume path). Raises
+    ``SnapshotCorruptError`` on any integrity failure. Sidecars written
+    before the CRC frame (a bare pickle) still load — the frame is
+    additive, not a format break."""
+    path = replay_sidecar_path(run_dir, process_index)
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        blob = f.read()
+    if blob[:4] == _SIDECAR_MAGIC:
+        if len(blob) < _SIDECAR_HEAD.size:
+            raise SnapshotCorruptError(f"{path}: truncated sidecar header")
+        _magic, version, crc = _SIDECAR_HEAD.unpack_from(blob, 0)
+        if version != SIDECAR_VERSION:
+            raise SnapshotCorruptError(
+                f"{path}: unknown sidecar version {version}")
+        payload = blob[_SIDECAR_HEAD.size:]
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            raise SnapshotCorruptError(
+                f"{path}: CRC mismatch — torn write or bit rot; "
+                "refusing the snapshot")
+    else:
+        payload = blob  # pre-CRC legacy sidecar: bare pickle
+    try:
+        d = pickle.loads(payload)
+        snap, step = d["snap"], int(d.get("step", -1))
+    except SnapshotCorruptError:
+        raise
+    except Exception as e:
+        raise SnapshotCorruptError(f"{path}: undecodable sidecar ({e})")
+    return snap, step
 
 
 class CheckpointManager:

@@ -31,10 +31,20 @@ from collections import deque
 
 
 class FlightRecorder:
-    def __init__(self, maxlen: int = 2048):
+    # Dump retention: a chaos soak that dumps on every kill would grow
+    # docs/evidence/fleet/ without bound; ``dump`` prunes its own
+    # ``flight_*.json`` family (never the fleet artifacts) down to the
+    # newest ``keep_dumps`` after each write. Class default, overridable
+    # per instance or per call.
+    keep_dumps = 32
+
+    def __init__(self, maxlen: int = 2048, keep_dumps: int | None = None):
         self._mu = threading.Lock()
         self._ring: deque = deque(maxlen=int(maxlen))
         self._seq = 0
+        self._dump_seq = 0
+        if keep_dumps is not None:
+            self.keep_dumps = int(keep_dumps)
         self.enabled = True
 
     def record(self, kind: str, **fields) -> None:
@@ -60,15 +70,26 @@ class FlightRecorder:
             self._seq = 0
 
     def dump(self, directory: str, reason: str,
-             extra: dict | None = None) -> str:
+             extra: dict | None = None, keep: int | None = None) -> str:
         """Write the ring as a JSON postmortem; returns the path. The
         filename carries a wall-clock stamp + the reason so a directory
-        of dumps reads as an incident log."""
+        of dumps reads as an incident log; a per-process dump sequence
+        and the pid keep same-second dumps (two supervisor kills in one
+        second, two harnesses in one test run) from colliding while
+        lexical sort stays chronological. After writing, the directory
+        is pruned to the newest ``keep`` (default ``keep_dumps``)
+        ``flight_*.json`` files — the fleet artifacts beside them are
+        never touched."""
         os.makedirs(directory, exist_ok=True)
         stamp = time.strftime("%Y%m%d-%H%M%S")
+        with self._mu:
+            self._dump_seq += 1
+            seq = self._dump_seq
         safe = "".join(c if c.isalnum() or c in "-_" else "_"
                        for c in reason)[:40]
-        path = os.path.join(directory, f"flight_{stamp}_{safe}.json")
+        path = os.path.join(
+            directory,
+            f"flight_{stamp}_{os.getpid():07d}-{seq:04d}_{safe}.json")
         payload = {
             "reason": reason,
             "dumped_at": stamp,
@@ -79,7 +100,35 @@ class FlightRecorder:
             payload["context"] = extra
         with open(path, "w") as f:
             json.dump(payload, f, indent=2, default=str)
+        prune_artifacts(directory, "flight_",
+                        self.keep_dumps if keep is None else keep)
         return path
+
+
+def prune_artifacts(directory: str, prefix: str, keep: int) -> list[str]:
+    """Bounded-evidence rule: keep the newest ``keep`` ``{prefix}*.json``
+    files in ``directory`` (newest = lexically greatest — both the
+    flight and fleet families stamp ``%Y%m%d-%H%M%S`` first, so lexical
+    order IS chronological order), delete the rest. Returns the deleted
+    paths; ``keep <= 0`` disables pruning (an explicit "keep everything"
+    for soak archaeology). Racing deleters are tolerated — a file
+    removed under us is someone else finishing the same prune."""
+    if keep <= 0:
+        return []
+    try:
+        names = sorted(n for n in os.listdir(directory)
+                       if n.startswith(prefix) and n.endswith(".json"))
+    except OSError:
+        return []
+    doomed = []
+    for name in names[:-keep] if len(names) > keep else []:
+        path = os.path.join(directory, name)
+        try:
+            os.remove(path)
+            doomed.append(path)
+        except OSError:
+            pass
+    return doomed
 
 
 # THE process-wide recorder: the receiver-side planes (replay service,

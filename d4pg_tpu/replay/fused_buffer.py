@@ -358,6 +358,27 @@ class FusedDeviceReplay:
             d["max_priority"] = float(self.trees.max_priority)
         return d
 
+    def snapshot(self) -> dict:
+        """Crash-recovery cut: ``state_dict`` (the drain inside it
+        collapses every staging ring head into the device ring, so the
+        cut has NO in-flight rows) plus the staging plane's ticket floor
+        when sharded — everything a fresh buffer needs to resume bitwise
+        at this point. Learner thread only, like ``state_dict``."""
+        d = self.state_dict()
+        stg = getattr(self._staging, "snapshot", None)
+        if stg is not None:
+            d["staging"] = stg()
+        return d
+
+    def restore(self, d: dict) -> None:
+        """Load a ``snapshot`` cut into this (fresh) buffer. Same caller
+        contract as ``load_state_dict``: reached under the service's
+        buffer lock (or single-threaded, e.g. the bench oracle)."""
+        self.load_state_dict(d)
+        stg = getattr(self._staging, "restore", None)
+        if stg is not None and "staging" in d:
+            stg(d["staging"])
+
     # restore mutates ring+tree state: reached via ReplayService.
     # load_replay_state under the buffer lock, like the paths above
     def load_state_dict(self, d: dict) -> None:  # jaxlint: guarded-by=_buffer_lock

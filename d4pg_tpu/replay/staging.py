@@ -126,6 +126,25 @@ class MultiRingStaging:
                 for piece in self._rings[i].take(n):
                     self._merge.push(piece)
 
+    # -- crash-recovery cut -------------------------------------------------
+    def snapshot(self) -> dict:
+        """Ticket floor + residual depth at a (drained) cut. The fused
+        buffer's ``state_dict`` drains every ring before calling this,
+        so ``staged_rows`` is 0 on a consistent snapshot — recorded
+        anyway so a non-quiesced cut is self-describing. Consuming one
+        ticket to learn the floor is benign: tickets only need to ascend
+        per ring, gaps never block the merge."""
+        floor = next(self._ticket)
+        return {"ticket_floor": int(floor), "staged_rows": len(self)}
+
+    def restore(self, d: dict) -> None:
+        """Reseat the ticket counter ABOVE the snapshot's floor so every
+        post-restore push stays merge-ordered after every pre-crash
+        ticket. Ring contents are NOT restored — a consistent cut has
+        none (see ``snapshot``); rows in flight at the crash are the
+        declared fence/shed losses of the recovery plane."""
+        self._ticket = itertools.count(int(d.get("ticket_floor", 0)) + 1)
+
     def frame(self):
         self._refill()
         return self._merge.frame()

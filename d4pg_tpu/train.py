@@ -170,7 +170,9 @@ def infer_dims(cfg: ExperimentConfig) -> tuple[int | tuple, int, np.dtype]:
 
 
 def _host_replay_path(run_dir: str, process_index: int) -> str:
-    return os.path.join(run_dir, f"replay_p{process_index}.pkl")
+    from d4pg_tpu.io.checkpoint import replay_sidecar_path
+
+    return replay_sidecar_path(run_dir, process_index)
 
 
 def _save_host_replay(run_dir: str, process_index: int, step: int,
@@ -181,16 +183,13 @@ def _save_host_replay(run_dir: str, process_index: int, step: int,
     with a coarser ``--checkpoint_replay_every`` cadence the LATEST state
     checkpoint usually lacks the payload and resume silently restarted
     with an empty buffer). Stamped with the learner step it was taken at.
-    Write-then-rename so a crash mid-save leaves the previous snapshot
-    intact."""
-    import pickle
+    The io-layer writer (``io/checkpoint.save_replay_sidecar``) does the
+    write-then-rename AND frames the pickle with a CRC, so a crash
+    mid-save leaves the previous snapshot intact and a torn file is
+    rejected cleanly at load instead of half-restoring."""
+    from d4pg_tpu.io.checkpoint import save_replay_sidecar
 
-    path = _host_replay_path(run_dir, process_index)
-    tmp = f"{path}.{os.getpid()}.tmp"
-    with open(tmp, "wb") as f:
-        pickle.dump({"step": int(step), "snap": snap},
-                    f, protocol=pickle.HIGHEST_PROTOCOL)
-    os.replace(tmp, path)
+    save_replay_sidecar(run_dir, process_index, step, snap)
 
 
 def _load_host_replay(run_dir: str, process_index: int,
@@ -204,18 +203,26 @@ def _load_host_replay(run_dir: str, process_index: int,
     state cadence). A snapshot NEWER than the state is refused: the save
     site commits the state checkpoint BEFORE renaming the sidecar, so
     ahead-of-state means mixed-up run dirs or a rolled-back checkpoint.
-    Multi-host fused restores additionally require the snapshot step to
-    AGREE across hosts (see the resume site) — per-host staleness is
-    fine for independent host buffers, but the sharded device buffer is
-    one logical store whose shard-sets must come from one save moment."""
-    import pickle
+    A CORRUPT sidecar (CRC/format failure) is refused the same way, with
+    the io layer's diagnostic — learner-only resume beats poisoning the
+    buffer with a torn snapshot. Multi-host fused restores additionally
+    require the snapshot step to AGREE across hosts (see the resume
+    site) — per-host staleness is fine for independent host buffers, but
+    the sharded device buffer is one logical store whose shard-sets must
+    come from one save moment."""
+    from d4pg_tpu.io.checkpoint import (SnapshotCorruptError,
+                                        load_replay_sidecar)
 
-    path = _host_replay_path(run_dir, process_index)
-    if not os.path.exists(path):
+    try:
+        loaded = load_replay_sidecar(run_dir, process_index)
+    except SnapshotCorruptError as e:
+        print(f"[p{process_index}] replay sidecar is corrupt ({e}); "
+              "refusing it — resuming learner-only with an empty buffer",
+              flush=True)
         return None, -1
-    with open(path, "rb") as f:
-        payload = pickle.load(f)
-    snap_step = int(payload.get("step", -1))
+    if loaded is None:
+        return None, -1
+    snap, snap_step = loaded
     if snap_step > int(step):
         print(f"[p{process_index}] replay sidecar is from step "
               f"{snap_step}, AHEAD of the restored state at step {step}; "
@@ -227,7 +234,22 @@ def _load_host_replay(run_dir: str, process_index: int,
               f"{snap_step} ({int(step) - snap_step} steps behind the "
               "restored state); resuming with the slightly-stale buffer",
               flush=True)
-    return payload["snap"], snap_step
+    return snap, snap_step
+
+
+def _restore_replay(service, snap: dict, env_steps: int) -> None:
+    """Land a sidecar snapshot in the service. A SERVICE-level snapshot
+    (crash-recovery plane: buffer cut + ticket floor + generation) goes
+    through ``ReplayService.restore`` — which also bumps the generation
+    so pre-crash raw frames fence at admission; a legacy buffer-only
+    dict keeps the old ``load_replay_state`` path. The env-step counter
+    stays with the CHECKPOINT's value either way: a stale sidecar must
+    not roll the interaction ledger back below the restored state's."""
+    if isinstance(snap, dict) and "buffer" in snap:
+        service.restore(snap)
+        service.set_env_steps(env_steps)
+    else:
+        service.load_replay_state(snap)
 
 
 def train(cfg: ExperimentConfig) -> dict:
@@ -503,13 +525,13 @@ def train(cfg: ExperimentConfig) -> dict:
                 agreed = (int(steps_all.min()) == int(steps_all.max())
                           and int(steps_all.min()) >= 0)
                 if agreed:
-                    service.load_replay_state(snap)
+                    _restore_replay(service, snap, env_steps)
                 elif snap is not None:
                     print(f"[p{jax.process_index()}] replay sidecar steps "
                           f"disagree across hosts ({steps_all.tolist()}); "
                           "all hosts restart with empty replay", flush=True)
             elif snap is not None:
-                service.load_replay_state(snap)
+                _restore_replay(service, snap, env_steps)
             print(f"[p{jax.process_index()}] resumed from step "
                   f"{int(jax.device_get(state.step))} ({service.env_steps} "
                   f"env steps, {len(service)} replay rows)", flush=True)
@@ -527,7 +549,7 @@ def train(cfg: ExperimentConfig) -> dict:
         if snap is None:
             snap, _ = _load_host_replay(run_dir, 0, int(state.step))
         if snap:
-            service.load_replay_state(snap)
+            _restore_replay(service, snap, extra.get("env_steps", 0))
         print(f"resumed from step {int(state.step)} "
               f"({service.env_steps} env steps, "
               f"{len(service)} replay rows)")
@@ -644,6 +666,10 @@ def train(cfg: ExperimentConfig) -> dict:
             num_shards=cfg.ingest_shards,
             on_payload=(service.add_payload if cfg.ingest_shards > 1
                         else None),
+            # crash-recovery plane: greet every connecting sender with the
+            # live service generation; after a restart-and-restore, frames
+            # encoded against the pre-crash service fence at admission
+            generation=(lambda: service.generation),
         )
         weight_server = WeightServer(weights, host=cfg.serve_host,
                                      port=cfg.serve_weights_port,
@@ -677,7 +703,10 @@ def train(cfg: ExperimentConfig) -> dict:
                 target=run_local_actor_process,
                 args=(proc_cfg, connect_host, receiver.port,
                       weight_server.port, f"proc-{i}",
-                      cfg.serve_secret or None),
+                      cfg.serve_secret or None,
+                      # both sides are ours: read the generation greeting so
+                      # a learner restart fences this child's stale frames
+                      True),
                 daemon=True,
             )
             p.start()
@@ -1110,15 +1139,19 @@ def train(cfg: ExperimentConfig) -> dict:
                         # restore would refuse, emptying the buffer (the
                         # exact failure the sidecar exists to prevent)
                         ckpt.wait()
-                    # every host's buffer goes to its step-stamped sidecar
-                    # (process 0 included) at a coarser cadence than the
-                    # state checkpoint — the ring snapshot holds the buffer
-                    # lock and (device storage) pays a full D2H copy.
-                    # Restore tolerates the resulting staleness; an Orbax
-                    # extra payload would instead vanish whenever the
-                    # retention window outran the replay cadence.
+                    # every host's SERVICE snapshot goes to its step-stamped
+                    # sidecar (process 0 included) at a coarser cadence than
+                    # the state checkpoint — the ring snapshot holds the
+                    # buffer lock and (device storage) pays a full D2H copy.
+                    # A service snapshot (vs the old buffer-only dict) also
+                    # carries the admission-ticket floor + generation, so a
+                    # crash-restart fences pre-crash frames and resumes
+                    # merge-ordered. Restore tolerates the resulting
+                    # staleness; an Orbax extra payload would instead vanish
+                    # whenever the retention window outran the replay
+                    # cadence.
                     _save_host_replay(run_dir, jax.process_index(), lstep,
-                                      service.replay_state())
+                                      service.snapshot(quiesce_timeout=2.0))
     stop_actors.set()
     for t in actor_threads.values():
         t.join(timeout=10.0)

@@ -1,0 +1,481 @@
+"""Crash-recovery plane: durable snapshots, generation fencing, chaos.
+
+The contracts pinned here, one by one:
+  - ``ReplayService.snapshot()/restore()`` round-trips the host buffer
+    BITWISE into a fresh service (columns, PER state, write head, seq
+    floor) and bumps the generation past the snapshot's;
+  - the generation fence: a raw frame stamped with a pre-restart
+    generation is accepted-but-fenced (declared loss, never a duplicate),
+    while current-generation and non-opted-in legacy frames commit;
+  - the checkpoint sidecar refuses torn/corrupt bytes loudly
+    (``SnapshotCorruptError``), loads legacy bare pickles, and the
+    train-level loader degrades to learner-only instead of poisoning the
+    buffer;
+  - the learner-kill chaos harness survives seeded mid-run service kills
+    with zero deadlocks/hierarchy violations and reports MTTR + fence
+    accounting + the reconnect-storm spread;
+  - the deterministic recovery probe's post-restore oracle is bitwise;
+  - flight-dump retention is bounded, collision-free, and never touches
+    the fleet artifacts beside it;
+  - the newest committed fleet artifact carries the recovery block
+    (the schema gate — a later PR that drops it fails tier-1 here).
+"""
+
+import dataclasses
+import glob
+import json
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import d4pg_tpu
+from d4pg_tpu.distributed.replay_service import ReplayService
+from d4pg_tpu.distributed.transport import (
+    TransitionReceiver,
+    TransitionSender,
+)
+from d4pg_tpu.io.checkpoint import (
+    SnapshotCorruptError,
+    load_replay_sidecar,
+    replay_sidecar_path,
+    save_replay_sidecar,
+)
+from d4pg_tpu.replay.uniform import ReplayBuffer, TransitionBatch
+
+PACKAGE_DIR = os.path.dirname(os.path.abspath(d4pg_tpu.__file__))
+REPO_ROOT = os.path.dirname(PACKAGE_DIR)
+
+
+def _batch(n=8, obs_dim=6, act_dim=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return TransitionBatch(
+        obs=rng.standard_normal((n, obs_dim)).astype(np.float32),
+        action=rng.standard_normal((n, act_dim)).astype(np.float32),
+        reward=rng.standard_normal(n).astype(np.float32),
+        next_obs=rng.standard_normal((n, obs_dim)).astype(np.float32),
+        done=np.zeros(n, np.float32),
+        discount=np.full(n, 0.99, np.float32),
+    )
+
+
+def _wait_for(pred, timeout=5.0):
+    """send() returns once bytes hit the socket; admission happens on
+    the receiver's connection thread — poll the service-side effect."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+def _bitwise(x, y) -> bool:
+    if isinstance(x, dict):
+        return (isinstance(y, dict) and x.keys() == y.keys()
+                and all(_bitwise(x[k], y[k]) for k in x))
+    if isinstance(x, (list, tuple)):
+        return (isinstance(y, (list, tuple)) and len(x) == len(y)
+                and all(_bitwise(a, b) for a, b in zip(x, y)))
+    xa, ya = np.asarray(x), np.asarray(y)
+    return xa.dtype == ya.dtype and bool(np.array_equal(xa, ya))
+
+
+# ------------------------------------------------ snapshot / restore ----
+
+@pytest.mark.recovery
+def test_snapshot_restore_roundtrip_bitwise():
+    """A snapshot restored into a FRESH service reproduces the buffer
+    bitwise and carries the cut's env-step/commit accounting."""
+    a = ReplayService(ReplayBuffer(1024, 6, 2))
+    try:
+        for i in range(5):
+            a.add(_batch(seed=i), actor_id="rt")
+        a.flush()
+        snap = a.snapshot()
+        a_state = a.replay_state()
+        a_steps = a.env_steps
+    finally:
+        a.close()
+    assert snap["env_steps"] == a_steps and a_steps == 40
+
+    b = ReplayService(ReplayBuffer(1024, 6, 2))
+    try:
+        b.restore(snap)
+        assert _bitwise(b.replay_state(), a_state)
+        assert b.env_steps == a_steps
+        # the restored incarnation serves a LATER generation than the cut
+        assert b.generation > int(snap["generation"])
+        # and keeps committing cleanly past the restored floor
+        b.add(_batch(seed=99), actor_id="rt")
+        b.flush()
+        assert b.env_steps == a_steps + 8
+    finally:
+        b.close()
+
+
+@pytest.mark.recovery
+def test_restore_rejects_snapshot_without_buffer():
+    svc = ReplayService(ReplayBuffer(256, 6, 2))
+    try:
+        with pytest.raises(ValueError):
+            svc.restore({"schema": 1, "env_steps": 0})
+    finally:
+        svc.close()
+
+
+@pytest.mark.recovery
+def test_restore_never_rewinds_generation():
+    """A STALE snapshot (older generation than the constructor floor)
+    must not rewind the serving id — rewinding would un-fence a prior
+    incarnation's retried frames into silent duplicates."""
+    a = ReplayService(ReplayBuffer(256, 6, 2))
+    try:
+        a.add(_batch(seed=1), actor_id="g")
+        a.flush()
+        snap = a.snapshot()  # generation 0
+    finally:
+        a.close()
+    b = ReplayService(ReplayBuffer(256, 6, 2), generation=7)
+    try:
+        b.restore(snap)
+        assert b.generation == 7  # max(floor, snap+1), not snap+1 == 1
+    finally:
+        b.close()
+
+
+# ------------------------------------------------- generation fence ----
+
+@pytest.mark.recovery
+def test_generation_fence_end_to_end_tcp():
+    """A sender greeted with a PRE-restart generation has its raw frames
+    fenced by a later-generation service: send() succeeds (declared
+    loss, not an error), zero rows commit, and the fence ledger counts
+    frame + rows."""
+    svc = ReplayService(ReplayBuffer(1024, 6, 2), generation=1)
+    recv = TransitionReceiver(
+        lambda b, aid, c: None, host="127.0.0.1",
+        on_payload=lambda p, shard, codec: svc.add_payload(p, shard, codec),
+        generation=0)  # the dead incarnation's greeting
+    sender = TransitionSender("127.0.0.1", recv.port, actor_id="stale",
+                              codec="raw", expect_generation=True,
+                              retry_timeout=5.0)
+    try:
+        assert sender.send(_batch(seed=3)) is True
+        assert sender.generation == 0  # learned from the greeting
+        assert _wait_for(
+            lambda: svc.ingest_stats()["fenced_frames"] == 1)
+        svc.flush()
+        stats = svc.ingest_stats()
+        assert stats["fenced_frames"] == 1
+        assert stats["fenced_rows"] == 8
+        assert svc.env_steps == 0  # nothing committed — and no duplicate
+    finally:
+        sender.close()
+        recv.close()
+        svc.close()
+
+
+@pytest.mark.recovery
+def test_current_generation_frames_commit():
+    """The same opt-in wiring at the CURRENT generation commits rows
+    normally — the fence only bites pre-restart stamps."""
+    svc = ReplayService(ReplayBuffer(1024, 6, 2), generation=2)
+    recv = TransitionReceiver(
+        lambda b, aid, c: None, host="127.0.0.1",
+        on_payload=lambda p, shard, codec: svc.add_payload(p, shard, codec),
+        generation=(lambda: svc.generation))
+    sender = TransitionSender("127.0.0.1", recv.port, actor_id="live",
+                              codec="raw", expect_generation=True,
+                              retry_timeout=5.0)
+    try:
+        assert sender.send(_batch(seed=4)) is True
+        assert sender.generation == 2
+        assert _wait_for(lambda: svc.env_steps == 8)
+        stats = svc.ingest_stats()
+        assert stats["fenced_frames"] == 0
+    finally:
+        sender.close()
+        recv.close()
+        svc.close()
+
+
+@pytest.mark.recovery
+def test_legacy_sender_unaffected_by_greeting():
+    """A sender that does NOT opt in ignores the greeting bytes and its
+    unstamped frames are never fenced — the wire upgrade is additive."""
+    svc = ReplayService(ReplayBuffer(1024, 6, 2), generation=5)
+    recv = TransitionReceiver(
+        lambda b, aid, c: None, host="127.0.0.1",
+        on_payload=lambda p, shard, codec: svc.add_payload(p, shard, codec),
+        generation=(lambda: svc.generation))
+    sender = TransitionSender("127.0.0.1", recv.port, actor_id="legacy",
+                              codec="raw", retry_timeout=5.0)
+    try:
+        assert sender.send(_batch(seed=5)) is True
+        assert _wait_for(lambda: svc.env_steps == 8)
+        assert svc.ingest_stats()["fenced_frames"] == 0
+    finally:
+        sender.close()
+        recv.close()
+        svc.close()
+
+
+# ------------------------------------------------ checkpoint sidecar ----
+
+def _snap_fixture():
+    return {"schema": 1, "env_steps": 17,
+            "buffer": {"obs": np.arange(12, dtype=np.float32)}}
+
+
+@pytest.mark.recovery
+def test_sidecar_roundtrip(tmp_path):
+    run_dir = str(tmp_path)
+    save_replay_sidecar(run_dir, 0, 42, _snap_fixture())
+    loaded = load_replay_sidecar(run_dir, 0)
+    assert loaded is not None
+    snap, step = loaded
+    assert step == 42
+    assert _bitwise(snap, _snap_fixture())
+
+
+@pytest.mark.recovery
+def test_sidecar_missing_returns_none(tmp_path):
+    assert load_replay_sidecar(str(tmp_path), 3) is None
+
+
+@pytest.mark.recovery
+def test_sidecar_corrupt_rejected(tmp_path):
+    """A flipped payload byte, a truncated header, and an unknown
+    version are all refused with SnapshotCorruptError — never fed to
+    load_state_dict."""
+    run_dir = str(tmp_path)
+    path = save_replay_sidecar(run_dir, 0, 7, _snap_fixture())
+    blob = bytearray(open(path, "rb").read())
+
+    torn = bytearray(blob)
+    torn[-1] ^= 0xFF  # bit rot in the pickle body
+    open(path, "wb").write(bytes(torn))
+    with pytest.raises(SnapshotCorruptError):
+        load_replay_sidecar(run_dir, 0)
+
+    open(path, "wb").write(bytes(blob[:6]))  # torn mid-header
+    with pytest.raises(SnapshotCorruptError):
+        load_replay_sidecar(run_dir, 0)
+
+    versioned = bytearray(blob)
+    versioned[4] = 250  # unknown format version
+    open(path, "wb").write(bytes(versioned))
+    with pytest.raises(SnapshotCorruptError):
+        load_replay_sidecar(run_dir, 0)
+
+
+@pytest.mark.recovery
+def test_sidecar_legacy_bare_pickle_loads(tmp_path):
+    """Pre-CRC sidecars (bare pickle, no magic frame) still load — the
+    integrity frame is additive, not a format break."""
+    run_dir = str(tmp_path)
+    with open(replay_sidecar_path(run_dir, 0), "wb") as f:
+        pickle.dump({"step": 9, "snap": _snap_fixture()}, f)
+    loaded = load_replay_sidecar(run_dir, 0)
+    assert loaded is not None
+    snap, step = loaded
+    assert step == 9 and _bitwise(snap, _snap_fixture())
+
+
+@pytest.mark.recovery
+def test_train_loader_degrades_to_learner_only(tmp_path, capsys):
+    """The train-level loader turns a corrupt sidecar into a LOUD
+    learner-only resume: (None, -1) plus the refusal diagnostic."""
+    from d4pg_tpu.train import _load_host_replay
+
+    run_dir = str(tmp_path)
+    path = save_replay_sidecar(run_dir, 0, 7, _snap_fixture())
+    blob = bytearray(open(path, "rb").read())
+    blob[-1] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    snap, step = _load_host_replay(run_dir, 0, 7)
+    assert snap is None and step == -1
+    out = capsys.readouterr().out
+    assert "corrupt" in out and "learner-only" in out
+
+    # a sidecar AHEAD of the restored state is refused the same way
+    save_replay_sidecar(run_dir, 0, 100, _snap_fixture())
+    snap, step = _load_host_replay(run_dir, 0, 7)
+    assert snap is None and step == -1
+    assert "AHEAD" in capsys.readouterr().out
+
+    # a slightly-STALE sidecar is accepted with a warning
+    save_replay_sidecar(run_dir, 0, 5, _snap_fixture())
+    snap, step = _load_host_replay(run_dir, 0, 7)
+    assert snap is not None and step == 5
+    assert "behind the restored state" in capsys.readouterr().out
+
+
+# ------------------------------------------------- learner-kill chaos ----
+
+@pytest.mark.recovery
+@pytest.mark.fleet
+def test_service_chaos_smoke():
+    """A small seeded fleet survives mid-run service kills: the
+    supervisor restores from the latest snapshot, actors re-handshake,
+    and the run ends with zero deadlocks/hierarchy violations and a
+    populated recovery block (MTTR, fence ledger, storm spread)."""
+    from d4pg_tpu.fleet import FleetConfig, FleetHarness
+    from d4pg_tpu.fleet.sweep import default_service_chaos
+
+    cfg = FleetConfig(
+        n_actors=6, duration_s=6.0, rows_per_sec=60.0, block_rows=16,
+        obs_dim=12, act_dim=3, capacity=40_000, ingest_shards=2,
+        codec="raw", send_timeout=0.5,
+        chaos=default_service_chaos(seed=11, duration_s=6.0),
+    )
+    result = FleetHarness(cfg).run()
+    sc = result["service_chaos"]
+    assert sc is not None
+    assert sc["kills"] >= 1
+    assert sc["restarts"] >= 1
+    assert sc["failed_restarts"] == 0
+    assert sc["final_generation"] == sc["kills"]
+    assert sc["snapshots"] >= 1
+    assert sc["mttr_s"]["n"] == sc["restarts"]
+    assert sc["mttr_s"]["max_s"] < 30.0
+    assert result["deadlocks"] == 0
+    assert result["locks"]["hierarchy_violations"] == 0
+    # the reconnect-storm guard actually spread the re-handshake wave
+    storm = sc["reconnect_storm"]
+    assert storm["jitters"] >= 1
+    assert storm["distinct"] >= 1
+    # rows still flowed after the restarts
+    assert result["rows_inserted"] > 0
+
+
+@pytest.mark.recovery
+def test_service_chaos_requires_raw_codec_and_threads():
+    """npz frames carry no generation stamp — service chaos over npz
+    would re-admit pre-crash retries as silent duplicates, so the
+    config refuses the combination outright."""
+    from d4pg_tpu.fleet import FleetConfig
+    from d4pg_tpu.fleet.sweep import default_service_chaos
+
+    chaos = default_service_chaos(seed=0, duration_s=5.0)
+    with pytest.raises(ValueError, match="raw"):
+        FleetConfig(n_actors=2, codec="npz", chaos=chaos)
+    with pytest.raises(ValueError):
+        FleetConfig(n_actors=2, codec="raw", mode="process", chaos=chaos)
+
+
+@pytest.mark.recovery
+def test_kill_schedule_seeded_and_bounded():
+    from d4pg_tpu.fleet import ChaosPolicy
+    from d4pg_tpu.fleet.sweep import default_service_chaos
+
+    chaos = default_service_chaos(seed=5, duration_s=10.0)
+    a = ChaosPolicy(chaos).service_kill_schedule(10.0)
+    b = ChaosPolicy(chaos).service_kill_schedule(10.0)
+    assert a == b and len(a) == chaos.service_kill_count
+    assert all(0.1 <= t < 10.0 for t in a)
+    other = dataclasses.replace(chaos, seed=6)
+    assert ChaosPolicy(other).service_kill_schedule(10.0) != a
+
+
+@pytest.mark.recovery
+def test_recovery_probe_oracle_bitwise():
+    """Kill-and-restore equals an uninterrupted run, modulo the declared
+    losses — the acceptance oracle, at probe scale."""
+    from d4pg_tpu.fleet.sweep import recovery_probe
+
+    out = recovery_probe(seed=1, blocks=12, block_rows=8, obs_dim=6,
+                         act_dim=2, cut=6, lost=2)
+    assert out["oracle_bitwise_equal"] is True
+    assert out["rows_lost_declared"] == 2 * 8
+    assert out["rows_compared"] == (12 - 2) * 8
+
+
+# ---------------------------------------------------- dump retention ----
+
+@pytest.mark.recovery
+def test_flight_dump_retention_and_collision_free(tmp_path):
+    """Repeated dumps keep only the newest N flight files, with
+    collision-free names, and never touch the fleet artifacts beside
+    them."""
+    from d4pg_tpu.obs.flight import FlightRecorder
+
+    fleet_art = tmp_path / "fleet_20990101-000000_0000001.json"
+    fleet_art.write_text("{}")
+    rec = FlightRecorder(maxlen=16, keep_dumps=3)
+    rec.record("kill", generation=1)
+    paths = [rec.dump(str(tmp_path), "service_kill") for _ in range(7)]
+    assert len(set(paths)) == 7  # same-second dumps never collide
+    left = sorted(os.path.basename(p) for p in glob.glob(
+        str(tmp_path / "flight_*.json")))
+    assert len(left) == 3
+    # the newest three survived (stamp+seq names sort chronologically)
+    assert left == sorted(os.path.basename(p) for p in paths)[-3:]
+    assert fleet_art.exists()
+
+
+@pytest.mark.recovery
+def test_prune_artifacts_disabled_and_missing_dir(tmp_path):
+    from d4pg_tpu.obs.flight import prune_artifacts
+
+    (tmp_path / "flight_a.json").write_text("{}")
+    assert prune_artifacts(str(tmp_path), "flight_", 0) == []
+    assert (tmp_path / "flight_a.json").exists()
+    assert prune_artifacts(str(tmp_path / "nope"), "flight_", 5) == []
+
+
+# ------------------------------------------------ lock-plane audit ----
+
+@pytest.mark.recovery
+@pytest.mark.lint
+def test_snapshot_paths_keep_lock_graph_clean():
+    """The snapshot/restore plane must not have added lock-graph edges:
+    the whole-program graph stays cycle-free, and no held-while-acquiring
+    edge is witnessed inside a snapshot/restore/kill function (their
+    acquisitions are strictly sequential by design)."""
+    from d4pg_tpu.lint.engine import build_lock_graph
+
+    graph, errors = build_lock_graph([PACKAGE_DIR])
+    assert not errors
+    assert graph.cycles == []
+    offenders = [w for ws in graph.edges.values() for w in ws
+                 if any(f"({name})" in w for name in
+                        ("snapshot", "restore", "kill"))]
+    assert offenders == [], offenders
+
+
+# ------------------------------------------------- artifact schema ----
+
+@pytest.mark.recovery
+@pytest.mark.obs
+def test_fleet_artifact_recovery_schema():
+    """The newest committed fleet artifact must carry the recovery
+    block: the acceptance run's kills/restarts, MTTR, fence ledger,
+    reconnect-storm spread, and a TRUE bitwise oracle — a later PR that
+    drops any of it fails tier-1 here instead of silently shipping an
+    artifact with no recovery story."""
+    arts = sorted(glob.glob(os.path.join(
+        REPO_ROOT, "docs", "evidence", "fleet", "fleet_*.json")))
+    assert arts, "no committed fleet artifact"
+    with open(arts[-1]) as f:  # stamp-named: lexical order = newest last
+        artifact = json.load(f)
+    rec = artifact.get("recovery")
+    assert rec, "newest fleet artifact lost its recovery block"
+    assert rec["metric"] == "fleet_recovery" and rec["schema"] == 1
+    assert rec["kills"] >= 2  # the acceptance bar: >= 2 mid-run kills
+    assert rec["restarts"] >= 1
+    assert rec["failed_restarts"] == 0
+    assert rec["deadlocks"] == 0
+    assert rec["hierarchy_violations"] == 0
+    assert rec["mttr_s"]["n"] >= 1 and rec["mttr_s"]["max_s"] is not None
+    assert rec["final_generation"] >= 1
+    assert rec["rows_fenced"] >= 0 and rec["frames_fenced"] >= 0
+    storm = rec["reconnect_storm"]
+    assert {"jitters", "distinct", "spread_ms"} <= set(storm)
+    oracle = rec["oracle"]
+    assert oracle["oracle_bitwise_equal"] is True
+    assert oracle["rows_lost_declared"] >= 0
