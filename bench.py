@@ -444,6 +444,7 @@ def bench_fleet(ns=(8, 32, 64, 128, 256), duration_s: float = 10.0,
         default_chaos,
         run_recovery,
         run_sweep,
+        run_weights,
         shard_sweep,
     )
 
@@ -468,6 +469,16 @@ def bench_fleet(ns=(8, 32, 64, 128, 256), duration_s: float = 10.0,
     artifact["recovery"] = run_recovery(
         n_actors=max(64, min(ns)), duration_s=duration_s,
         ingest_shards=2, seed=seed)
+    # weight-broadcast block: one weight-chaos run (N>=64 pullers over a
+    # depth-2 relay tree, torn/stale injection, a relay crash and a
+    # learner kill at generation+1) — snapshots/s, delta hit-rate,
+    # pull->publish staleness percentiles, and the three run-gating
+    # oracles (accepted-frames ledger, trace orphans, lock hierarchy).
+    # Schema-checked in tier-1 (tests/test_weight_plane.py) like the
+    # latency and recovery blocks.
+    artifact["weights"] = run_weights(
+        n_pullers=max(64, min(ns)), relay_depth=2,
+        duration_s=duration_s, seed=seed, learner_kills=1)
     return artifact
 
 

@@ -57,6 +57,8 @@ def run_actor(
     codec: str = "npz",
     trace_sample: float = 0.0,
     expect_generation: bool = False,
+    weight_codec: str | None = None,
+    weight_delta: bool = True,
 ) -> int:
     cfg = cfg.resolve()
     obs_dim, act_dim, obs_dtype = infer_dims(cfg)
@@ -88,7 +90,18 @@ def run_actor(
                               codec=codec,
                               trace_sample=trace_sample,
                               expect_generation=expect_generation)
-    weights = WeightClient(learner_host, weights_port, secret=secret)
+    # --weight_codec opts into the v2 weight plane (delta-encoded pulls,
+    # optional bf16/int8 quantized transport, generation fencing across
+    # learner restarts); the default stays the v1 full-snapshot puller —
+    # the server answers both protocols on one port, per client.
+    if weight_codec is not None:
+        from d4pg_tpu.distributed.weight_plane import WeightPlaneClient
+
+        weights = WeightPlaneClient(learner_host, weights_port,
+                                    codec=weight_codec, delta=weight_delta,
+                                    secret=secret)
+    else:
+        weights = WeightClient(learner_host, weights_port, secret=secret)
     actor_cfg = ActorConfig(
         epsilon_0=cfg.epsilon_0, min_epsilon=cfg.min_epsilon,
         epsilon_horizon=cfg.epsilon_horizon, n_step=cfg.n_steps,
@@ -225,6 +238,17 @@ def main(argv=None):
                         "restarted learner fences pre-crash frames instead "
                         "of double-inserting them (requires a greeting "
                         "learner, e.g. train.py serve mode)")
+    p.add_argument("--weight_codec", choices=("f32", "bf16", "int8"),
+                   default=None,
+                   help="opt into the v2 weight plane with this transport "
+                        "codec: f32 (full precision), bf16 (2x smaller, "
+                        "rel err <= 2^-8) or int8 (4x smaller, per-tensor "
+                        "scale); default: the v1 full-snapshot puller")
+    p.add_argument("--weight_delta", type=int, choices=(0, 1), default=1,
+                   help="with --weight_codec: 1 (default) pulls per-tensor "
+                        "deltas against the last accepted version when the "
+                        "server still holds it in its window; 0 always "
+                        "pulls full frames")
     ns = p.parse_args(argv)
     if ns.actor_device == "cpu":
         # Acting runs on host CPU; force the platform BEFORE any jax call
@@ -245,7 +269,9 @@ def main(argv=None):
                       send_retries=ns.send_retries,
                       drop_on_timeout=bool(ns.drop_on_timeout),
                       codec=ns.codec, trace_sample=ns.trace_sample,
-                      expect_generation=bool(ns.expect_generation))
+                      expect_generation=bool(ns.expect_generation),
+                      weight_codec=ns.weight_codec,
+                      weight_delta=bool(ns.weight_delta))
     print(f"collected {steps} env steps")
 
 

@@ -162,6 +162,12 @@ class ExperimentConfig:
     serve_secret: str = ""  # shared secret gating remote peers ('' = open)
     serve_transitions_port: int = 0  # 0 = ephemeral
     serve_weights_port: int = 0
+    # Weight-broadcast version window (docs/architecture.md "Weight
+    # plane"): the server keeps this many recent versions so pullers
+    # inside the window receive per-tensor deltas instead of full
+    # snapshots; pullers outside it (or across a learner restart's
+    # generation bump) fall back to a full frame.
+    weight_window: int = 8
     # Receiver-side ingest shards (docs/architecture.md "Sharded
     # receiver"): K SO_REUSEPORT listeners + K decode/stage workers + one
     # ordered merge-commit thread. 1 = the legacy single-drain plane.
@@ -394,6 +400,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--serve_transitions_port", type=int,
                    default=d.serve_transitions_port)
     p.add_argument("--serve_weights_port", type=int, default=d.serve_weights_port)
+    p.add_argument("--weight_window", type=int, default=d.weight_window,
+                   help="weight-broadcast delta window: recent versions "
+                        "kept server-side so in-window pullers get "
+                        "per-tensor deltas instead of full snapshots")
     p.add_argument("--ingest_shards", type=int, default=d.ingest_shards,
                    help="receiver-side ingest shards: K SO_REUSEPORT "
                         "listeners + K decode/stage workers + one ordered "

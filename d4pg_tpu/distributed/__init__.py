@@ -17,6 +17,12 @@ D4PG paper shape the reference only gestures at (SURVEY.md §2):
 """
 
 from d4pg_tpu.distributed.weights import WeightStore
+from d4pg_tpu.distributed.weight_plane import (
+    WeightPlaneClient,
+    WeightPlaneServer,
+    WeightRelay,
+    WeightWireChaos,
+)
 from d4pg_tpu.distributed.replay_service import ReplayService
 from d4pg_tpu.distributed.actor import ActorConfig, ActorWorker
 from d4pg_tpu.distributed.evaluator import AsyncEvaluator, Evaluator
@@ -28,6 +34,10 @@ from d4pg_tpu.distributed.transport import (
 
 __all__ = [
     "WeightStore",
+    "WeightPlaneClient",
+    "WeightPlaneServer",
+    "WeightRelay",
+    "WeightWireChaos",
     "ReplayService",
     "ActorConfig",
     "ActorWorker",

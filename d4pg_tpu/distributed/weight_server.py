@@ -11,6 +11,13 @@ payload = npz of the flattened param pytree + version + step. The treedef
 is reconstructed client-side from sorted flat keys, so only arrays cross
 the wire. Replaces the reference's shared-memory ``state_dict`` pulls
 (``ddpg.py:118-120``, ``main.py:113-114``) for the cross-host case.
+
+This module is the v1 (full-snapshot npz) protocol; the delta/quantized/
+relay superset lives in ``weight_plane.py`` (``WeightPlaneServer``
+answers BOTH magics on one port, so v1 clients never break). The serve
+path memoizes the serialized frame by (version, codec) with single-flight
+fill under the declared ``wserve`` tier lock: N pullers of version v cost
+one flatten+savez, not N.
 """
 
 from __future__ import annotations
@@ -19,10 +26,13 @@ import io
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
+from d4pg_tpu.core.locking import TieredLock
 from d4pg_tpu.distributed.weights import WeightStore
+from d4pg_tpu.obs.flight import record_event
 
 _MAGIC = 0xD4F7
 _REQ = struct.Struct("!Iq")
@@ -65,12 +75,23 @@ class WeightServer(ConnRegistry):
         super().__init__()
         self._store = store
         self._secret = secret
+        # Frame memo, guarded by the declared ``wserve`` tier lock
+        # (above ``wstore``: the fill path snapshots the store while
+        # holding it). Holding the lock ACROSS the fill is the
+        # single-flight: concurrent pullers of the same version block on
+        # the lock and find the finished frame, instead of each paying
+        # flatten+savez. Keyed (version, codec) — v1 has one codec, the
+        # plane subclass reuses the same lock for its per-codec caches.
+        self._frame_lock = TieredLock("wserve")
+        self._frame_memo: tuple[tuple[int, str], bytes] | None = None
+        self.frame_encodes = 0  # fills (cache misses); serves can exceed it
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind((host, port))
         self._server.listen()
         self.port = self._server.getsockname()[1]
         self._stop = threading.Event()
+        self._conn_threads: list[threading.Thread] = []
         self._thread = threading.Thread(target=self._accept, daemon=True)
         self._thread.start()
 
@@ -84,7 +105,48 @@ class WeightServer(ConnRegistry):
             except OSError:
                 return
             self._register_conn(conn)
-            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+            self._conn_threads = [t for t in self._conn_threads if t.is_alive()]
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            self._conn_threads.append(t)
+            t.start()
+
+    def _legacy_frame(self, have: int) -> bytes | None:
+        """The memoized v1 response body for a puller at ``have``: None
+        when nothing newer exists, else the (version, 'npz')-keyed npz
+        frame — filled single-flight under ``_frame_lock``."""
+        with self._frame_lock:
+            # snapshot_ex() reads (version, params, step, norm) under one
+            # store lock: a publish landing between separate reads would
+            # stamp step-N params with a newer step, corrupting the
+            # client's staleness accounting.
+            snap = self._store.snapshot_ex()
+            version, params = snap["version"], snap["params"]
+            if params is None or version <= have:
+                return None
+            key = (version, "npz")
+            if self._frame_memo is not None and self._frame_memo[0] == key:
+                return self._frame_memo[1]
+            flat = _flatten(params)
+            norm = snap["norm_stats"]
+            if norm is not None:
+                # piggyback acting statistics (obs normalization):
+                # remote actors must standardize policy inputs with
+                # the same stats the learner's replay rows use
+                flat["__norm_mean__"] = np.asarray(norm[0])
+                flat["__norm_std__"] = np.asarray(norm[1])
+                if len(norm) > 2:  # clip radius travels with stats
+                    flat["__norm_clip__"] = np.float64(norm[2])
+            buf = io.BytesIO()
+            np.savez(
+                buf,
+                __version__=np.int64(version),
+                __step__=np.int64(snap["step"]),
+                **flat,
+            )
+            payload = buf.getvalue()
+            self._frame_memo = (key, payload)
+            self.frame_encodes += 1
+            return payload
 
     def _serve(self, conn: socket.socket) -> None:
         try:
@@ -98,32 +160,10 @@ class WeightServer(ConnRegistry):
                     magic, have = _REQ.unpack(req)
                     if magic != _MAGIC:
                         return
-                    # snapshot() reads (version, params, step) under one
-                    # lock: a publish landing between separate reads would
-                    # stamp step-N params with a newer step, corrupting the
-                    # client's staleness accounting.
-                    version, params, step = self._store.snapshot()
-                    if params is None or version <= have:
+                    payload = self._legacy_frame(have)
+                    if payload is None:
                         conn.sendall(_RESP.pack(_MAGIC, 0))
                         continue
-                    buf = io.BytesIO()
-                    flat = _flatten(params)
-                    norm = getattr(self._store, "norm_stats", None)
-                    if norm is not None:
-                        # piggyback acting statistics (obs normalization):
-                        # remote actors must standardize policy inputs with
-                        # the same stats the learner's replay rows use
-                        flat["__norm_mean__"] = np.asarray(norm[0])
-                        flat["__norm_std__"] = np.asarray(norm[1])
-                        if len(norm) > 2:  # clip radius travels with stats
-                            flat["__norm_clip__"] = np.float64(norm[2])
-                    np.savez(
-                        buf,
-                        __version__=np.int64(version),
-                        __step__=np.int64(step),
-                        **flat,
-                    )
-                    payload = buf.getvalue()
                     conn.sendall(_RESP.pack(_MAGIC, len(payload)) + payload)
         except OSError:
             return  # peer died mid-frame (actor terminated); drop it
@@ -137,6 +177,13 @@ class WeightServer(ConnRegistry):
         except OSError:
             pass
         self._shutdown_conns()
+        # Join conn threads so their teardown work (the plane subclass
+        # sheds in-flight trace spans in its _serve finally) completes
+        # before close() returns — otherwise a trace snapshot taken
+        # right after close() races the sweeps and reports orphans.
+        for t in self._conn_threads:
+            t.join(timeout=2.0)
+        self._conn_threads.clear()
 
 
 class WeightClient(ReconnectingClient):
@@ -153,7 +200,12 @@ class WeightClient(ReconnectingClient):
     (``ProtocolError``: bad magic, oversized payload) are NOT absorbed —
     they surface at the first frame, since reconnecting cannot heal a
     version/config fault. The initial connect fails fast, surfacing
-    config errors at startup."""
+    config errors at startup.
+
+    Stale-degradation entry/exit is recorded on the flight-recorder ring
+    (``weight_stale_enter``/``weight_stale_exit``), so a silent-stale
+    period shows up in a chaos postmortem with its duration instead of
+    leaving a gap between ordinary pull events."""
 
     def __init__(self, host: str, port: int, connect_timeout: float = 10.0,
                  secret: str | None = None, down_timeout: float = 300.0,
@@ -173,8 +225,6 @@ class WeightClient(ReconnectingClient):
         self.norm_stats: tuple | None = None  # (mean, std) when served
 
     def get_if_newer(self, have_version: int):
-        import time
-
         with self._lock:
             self._check_open()
             if (self._sock is None and self._ever_pulled
@@ -189,6 +239,11 @@ class WeightClient(ReconnectingClient):
                 # the server ANSWERED (even "nothing newer"): the secret
                 # and protocol are good, stale-degradation is armed
                 self._ever_pulled = True
+                if self._down_since is not None:
+                    record_event("weight_stale_exit",
+                                 addr=f"{self._addr[0]}:{self._addr[1]}",
+                                 down_s=round(
+                                     time.monotonic() - self._down_since, 3))
                 self._down_since = None
             except ProtocolError:
                 self._drop_sock()
@@ -206,6 +261,9 @@ class WeightClient(ReconnectingClient):
                 now = time.monotonic()
                 if self._down_since is None:
                     self._down_since = now
+                    record_event("weight_stale_enter",
+                                 addr=f"{self._addr[0]}:{self._addr[1]}",
+                                 have_version=int(have_version))
                 if now - self._down_since > self._down_timeout:
                     raise ConnectionError(
                         f"weight server unreachable for "

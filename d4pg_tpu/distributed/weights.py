@@ -6,26 +6,46 @@ Replaces the reference's shared-memory ``state_dict`` pulls
 number; actors/evaluators *pull* when they see a newer version. Host-side
 numpy copies keep the store process-agnostic (the same interface backs a
 DCN broadcast: publish serializes once, subscribers fetch).
+
+The store additionally carries the weight plane's crash-fencing state
+(``weight_plane.py``): a **generation** (the PR-7 idiom — a restarted
+learner's store is constructed at ``generation+1``, so version numbers
+that rewind across a crash are disambiguated by the pair
+``(generation, version)``) and a monotonic **publish timestamp** (the
+anchor for the plane's pull→publish staleness histogram). Relays
+republish upstream snapshots verbatim via ``publish_versioned`` —
+version, step, generation and the ORIGINAL publish timestamp all pass
+through, so staleness measured at a fan-out leaf is end-to-end, not
+per-hop.
 """
 
 from __future__ import annotations
 
-import threading
+import time
 from typing import Any
 
 import jax
 import numpy as np
 
+from d4pg_tpu.core.locking import TieredLock
+
 
 class WeightStore:
-    """Thread-safe versioned parameter store (single-writer, many-reader)."""
+    """Thread-safe versioned parameter store (single-writer, many-reader).
 
-    def __init__(self):
-        self._lock = threading.Lock()
+    All state sits under one declared-tier lock (``wstore`` — the weight
+    plane's innermost tier): a server's frame cache refreshes from the
+    store while holding its own ``wserve`` cache lock, so the store lock
+    must admit acquisition below it."""
+
+    def __init__(self, generation: int = 0):
+        self._store_lock = TieredLock("wstore")
         self._version = 0
         self._params: Any = None
         self._step = 0
         self._norm_stats: tuple | None = None
+        self._generation = int(generation)
+        self._published_ts = 0.0
 
     def publish(self, params: Any, step: int, to_host: bool = True,
                 norm_stats: tuple | None = None) -> int:
@@ -39,39 +59,71 @@ class WeightStore:
         Returns the new version."""
         host = (jax.tree_util.tree_map(lambda x: np.asarray(x), params)
                 if to_host else params)
-        with self._lock:
+        now = time.monotonic()
+        with self._store_lock:
             self._version += 1
             self._params = host
             self._step = int(step)
+            self._published_ts = now
             if norm_stats is not None:
                 # (mean, std) snapshot of the replay-side obs normalizer;
                 # piggybacked to remote actors by the WeightServer
                 self._norm_stats = norm_stats
             return self._version
 
+    def publish_versioned(self, params: Any, version: int, step: int,
+                          norm_stats: tuple | None = None,
+                          generation: int | None = None,
+                          publish_ts: float | None = None) -> None:
+        """Relay-side: republish an UPSTREAM snapshot verbatim — version,
+        generation and the original monotonic publish timestamp pass
+        through unchanged (end-to-end staleness, not per-hop). Version
+        may rewind when ``generation`` advances (a restarted learner
+        publishes v1 of generation g+1); within a generation the relay's
+        puller only hands over strictly newer versions."""
+        now = time.monotonic()
+        with self._store_lock:
+            self._version = int(version)
+            self._params = params
+            self._step = int(step)
+            self._published_ts = float(publish_ts) if publish_ts else now
+            if norm_stats is not None:
+                self._norm_stats = norm_stats
+            if generation is not None:
+                self._generation = int(generation)
+
     @property
     def norm_stats(self) -> tuple | None:
         """Latest published (mean, std) acting statistics, or None when
         observation normalization is off. In-process readers holding the
         live RunningMeanStd ignore this; the TCP weight plane ships it."""
-        with self._lock:
+        with self._store_lock:
             return self._norm_stats
 
     @property
     def version(self) -> int:
-        with self._lock:
+        with self._store_lock:
             return self._version
+
+    @property
+    def generation(self) -> int:
+        """Crash-fencing generation (PR-7 idiom): bumped by constructing
+        the restarted learner's store at ``generation+1``; rides every
+        weight-plane frame so a relay can never serve a pre-crash
+        version as current."""
+        with self._store_lock:
+            return self._generation
 
     @property
     def step(self) -> int:
         """Learner step at last publish (replaces the shared global_count,
         ``main.py:386``)."""
-        with self._lock:
+        with self._store_lock:
             return self._step
 
     def get(self) -> tuple[int, Any]:
         """Reader-side: (version, params) — params None until first publish."""
-        with self._lock:
+        with self._store_lock:
             return self._version, self._params
 
     def snapshot(self) -> tuple[int, Any, int]:
@@ -79,11 +131,27 @@ class WeightStore:
         needs the step the params were published at (e.g. eval lag
         accounting); reading ``.step`` separately can observe a newer
         publish."""
-        with self._lock:
+        with self._store_lock:
             return self._version, self._params, self._step
 
+    def snapshot_ex(self) -> dict:
+        """The weight plane's atomic read: version, params, step,
+        generation, publish timestamp and norm stats under ONE lock
+        round trip — a publish landing between separate reads would pair
+        generation-g params with a generation-g+1 stamp, which is
+        exactly the fencing breach the pair exists to prevent."""
+        with self._store_lock:
+            return {
+                "version": self._version,
+                "params": self._params,
+                "step": self._step,
+                "generation": self._generation,
+                "published_ts": self._published_ts,
+                "norm_stats": self._norm_stats,
+            }
+
     def get_if_newer(self, have_version: int) -> tuple[int, Any] | None:
-        with self._lock:
+        with self._store_lock:
             if self._version > have_version:
                 return self._version, self._params
             return None

@@ -305,6 +305,27 @@ def run_recovery(
     }
 
 
+def run_weights(
+    n_pullers: int = 64,
+    relay_depth: int = 2,
+    duration_s: float = 8.0,
+    seed: int = 0,
+    learner_kills: int = 1,
+    **overrides,
+) -> dict:
+    """The bench_fleet weights block: one weight-chaos run
+    (``fleet/weight_chaos.py`` — N pullers across a relay tree, torn/
+    stale injection, relay crash, learner kill at generation+1) reported
+    as the broadcast headline numbers + the three run-gating oracles
+    (ledger / trace orphans / lock hierarchy)."""
+    from d4pg_tpu.fleet.weight_chaos import WeightChaosConfig, run_weight_chaos
+
+    return run_weight_chaos(WeightChaosConfig(
+        n_pullers=int(n_pullers), relay_depth=int(relay_depth),
+        duration_s=float(duration_s), learner_kills=int(learner_kills),
+        seed=int(seed), **overrides))
+
+
 def _lock_wait_ms(row: dict) -> float | None:
     """Total contended-acquisition wait across every tiered lock."""
     locks = row.get("locks")
@@ -352,6 +373,11 @@ def main(argv=None):
                          "only; shard sweep default: obs.trace."
                          "DEFAULT_SAMPLE on K>=2 rows, N sweep default: "
                          "off)")
+    ap.add_argument("--weights", action="store_true",
+                    help="run the weight-chaos harness (broadcast plane: "
+                         "N pullers over a relay tree, torn/stale/kill "
+                         "faults) instead of the ingest sweep")
+    ap.add_argument("--relay_depth", type=int, default=2)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--no_chaos", action="store_true",
                     help="clean-plane control run (all fault probs 0)")
@@ -360,7 +386,13 @@ def main(argv=None):
     ns = ap.parse_args(argv)
     chaos = (ChaosConfig(seed=ns.seed) if ns.no_chaos
              else default_chaos(ns.seed))
-    if ns.shards_sweep:
+    if ns.weights:
+        artifact = run_weights(
+            n_pullers=max(ns.ns), relay_depth=ns.relay_depth,
+            duration_s=ns.seconds, seed=ns.seed,
+            **({"torn_prob": 0.0, "stale_prob": 0.0, "learner_kills": 0,
+                "relay_kills": 0} if ns.no_chaos else {}))
+    elif ns.shards_sweep:
         artifact = shard_sweep(ks=tuple(ns.shards_sweep),
                                n_actors=max(ns.ns), duration_s=ns.seconds,
                                rows_per_sec=ns.rows_per_sec, chaos=chaos,

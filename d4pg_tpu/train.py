@@ -650,7 +650,7 @@ def train(cfg: ExperimentConfig) -> dict:
     actor_proc_fails: list[int] = [0] * max(0, cfg.actor_procs)
     if cfg.serve or cfg.actor_procs > 0:
         from d4pg_tpu.distributed.transport import TransitionReceiver
-        from d4pg_tpu.distributed.weight_server import WeightServer
+        from d4pg_tpu.distributed.weight_plane import WeightPlaneServer
 
         # K>1: shard-aware receiver — frames forwarded undecoded to the
         # owning ingest shard's worker (raw frames admit on header
@@ -671,9 +671,14 @@ def train(cfg: ExperimentConfig) -> dict:
             # encoded against the pre-crash service fence at admission
             generation=(lambda: service.generation),
         )
-        weight_server = WeightServer(weights, host=cfg.serve_host,
-                                     port=cfg.serve_weights_port,
-                                     secret=cfg.serve_secret or None)
+        # Weight plane (docs/architecture.md "Weight plane"): answers
+        # BOTH wire protocols on one port — v1 full-snapshot pullers
+        # (actor_main.py default) and v2 delta/quantized/fenced pullers
+        # (--weight_codec) — with the serialized-frame memo shared.
+        weight_server = WeightPlaneServer(weights, host=cfg.serve_host,
+                                          port=cfg.serve_weights_port,
+                                          secret=cfg.serve_secret or None,
+                                          window=cfg.weight_window)
         print(f"serving: transitions :{receiver.port} weights :{weight_server.port}",
               flush=True)
     if cfg.actor_procs > 0:

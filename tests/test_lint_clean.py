@@ -119,10 +119,12 @@ def test_lock_graph_clean_over_package():
     graph, errors = build_lock_graph([PACKAGE_DIR])
     assert not errors, errors
     assert graph.cycles == [], format_graph(graph)
-    # the ingest plane's locks are all discovered, with their tier labels
+    # the ingest plane's locks are all discovered, with their tier
+    # labels, and so are the weight plane's three
     for lock, tier in (("_lock", "service"), ("_buffer_lock", "buffer"),
                        ("_commit_cond", "commit"), ("cond", "shard"),
-                       ("_ring_locks", "ring")):
+                       ("_ring_locks", "ring"), ("_relay_lock", "wrelay"),
+                       ("_frame_lock", "wserve"), ("_store_lock", "wstore")):
         assert lock in graph.nodes, sorted(graph.nodes)
         assert graph.nodes[lock] == tier
     # every edge between tier-labeled locks DESCENDS the hierarchy

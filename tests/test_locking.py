@@ -31,9 +31,11 @@ def debug_mode():
 
 
 def test_hierarchy_table_shape():
-    # outermost first, strictly decreasing, the five declared tiers
-    assert list(HIERARCHY) == ["service", "buffer", "commit", "shard",
-                               "ring"]
+    # outermost first, strictly decreasing: the five ingest-plane tiers
+    # plus the weight plane's three (relay > server cache > store)
+    assert list(HIERARCHY) == ["service", "buffer", "commit",
+                               "wrelay", "wserve", "wstore",
+                               "shard", "ring"]
     tiers = list(HIERARCHY.values())
     assert tiers == sorted(tiers, reverse=True)
     assert len(set(tiers)) == len(tiers)

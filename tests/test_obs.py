@@ -498,3 +498,24 @@ def test_registry_export_covers_live_planes():
     finally:
         svc.close()
     assert "ingest" not in REGISTRY.export()
+
+
+def test_registry_export_covers_weight_plane():
+    """The weight plane registers an aggregate 'weights' provider: the
+    block is always present (module-lifetime registration, mirroring
+    'locks'), counts live servers, and folds per-server frame/byte/
+    oracle tallies plus the staleness histogram."""
+    from d4pg_tpu.distributed.weight_plane import WeightPlaneServer
+    from d4pg_tpu.distributed.weights import WeightStore
+
+    base = REGISTRY.export()["weights"]
+    assert "staleness_ms" in base
+    store = WeightStore()
+    srv = WeightPlaneServer(store)
+    try:
+        out = REGISTRY.export()["weights"]
+        assert out["servers"] >= base.get("servers", 0) + 1
+        assert "snapshots_built" in out
+        assert "delta_hit_rate" in out
+    finally:
+        srv.close()

@@ -1,0 +1,515 @@
+"""Weight-broadcast plane tests (``distributed/weight_plane.py`` +
+``fleet/weight_chaos.py``): codecs and their oracle bounds, bitwise
+delta reconstruction, the dual-protocol server (v1 pullers + v2
+delta/quantized/fenced pullers on one port), single-flight frame
+memoization, torn-payload rejection, generation fencing through relays,
+the stale-degradation contract, and the bench-artifact weights schema
+gate."""
+
+from __future__ import annotations
+
+import glob
+import io
+import json
+import os
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.distributed.weight_plane import (
+    BF16_REL_BOUND,
+    CODECS,
+    WeightPlaneClient,
+    WeightPlaneServer,
+    WeightRelay,
+    WeightWireChaos,
+    bf16_to_f32,
+    decode_flat,
+    delta_apply,
+    delta_encode,
+    encode_flat,
+    f32_to_bf16,
+    quant_error_excess,
+)
+from d4pg_tpu.distributed.weight_server import WeightClient, WeightServer
+from d4pg_tpu.distributed.weights import WeightStore
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.weights
+
+
+def _params(rng, d=24):
+    return {"actor": {"w0": rng.normal(size=(d, d)).astype(np.float32),
+                      "b0": rng.normal(size=(d,)).astype(np.float32)},
+            "meta": {"count": np.int64(7)}}
+
+
+def _flat(rng, d=24):
+    return {"a/w": rng.normal(size=(d, d)).astype(np.float32),
+            "a/b": rng.normal(size=(d,)).astype(np.float32),
+            "a/i": np.arange(d, dtype=np.int32),
+            "__norm_mean__": rng.normal(size=(4,))}
+
+
+def _pull_until(client, want_version, timeout=5.0, want_gen=None):
+    deadline = time.monotonic() + timeout
+    res = None
+    while time.monotonic() < deadline:
+        got = client.get_if_newer()
+        if got is not None:
+            res = got
+        if (client.version >= want_version
+                and (want_gen is None or client.generation == want_gen)):
+            return res
+        time.sleep(0.02)
+    raise AssertionError(
+        f"never reached v{want_version} (at v{client.version} "
+        f"gen{client.generation})")
+
+
+# ------------------------------------------------------------ codecs ----
+
+def test_bf16_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    x = (rng.normal(size=(512,)) * 10.0 ** rng.integers(-6, 6, size=512)
+         ).astype(np.float32)
+    back = bf16_to_f32(f32_to_bf16(x))
+    assert np.all(np.abs(back - x) <= BF16_REL_BOUND * np.abs(x) + 1e-40)
+    # exactly-representable values survive bitwise
+    exact = np.array([0.0, 1.0, -2.5, 0.15625], dtype=np.float32)
+    assert bf16_to_f32(f32_to_bf16(exact)).tobytes() == exact.tobytes()
+
+
+def test_encode_decode_all_codecs_and_oracle():
+    rng = np.random.default_rng(1)
+    flat = _flat(rng)
+    for codec in CODECS:
+        enc = encode_flat(flat, codec)
+        dec = decode_flat(enc)
+        assert dec.keys() == flat.keys()
+        # non-f32 and meta tensors travel raw whatever the codec
+        assert dec["a/i"].tobytes() == flat["a/i"].tobytes()
+        assert dec["__norm_mean__"].tobytes() == flat["__norm_mean__"].tobytes()
+        if codec == "f32":
+            assert dec["a/w"].tobytes() == flat["a/w"].tobytes()
+        # the quantization oracle: every tensor within its declared bound
+        assert quant_error_excess(flat, enc) <= 0
+
+
+def test_int8_zero_tensor_exact():
+    enc = encode_flat({"z": np.zeros(8, np.float32)}, "int8")
+    assert decode_flat(enc)["z"].tobytes() == np.zeros(8, np.float32).tobytes()
+
+
+def test_unknown_codec_rejected():
+    with pytest.raises(ValueError):
+        encode_flat({}, "fp4")
+    with pytest.raises(ValueError):
+        WeightPlaneClient("127.0.0.1", 1, codec="fp4")
+
+
+# ------------------------------------------------------------- delta ----
+
+def test_delta_roundtrip_bitwise_all_arms():
+    """Every delta arm — __same__, sparse XOR, full tensor, __dropped__,
+    new key — reconstructs bitwise."""
+    rng = np.random.default_rng(2)
+    base = encode_flat(_flat(rng), "f32")
+    new_flat = _flat(np.random.default_rng(2))
+    new_flat["a/w"][3] += 1.0                      # sparse change
+    new_flat["a/b"] = rng.normal(size=(24,)).astype(np.float32)  # full change
+    del new_flat["a/i"]                            # dropped
+    new_flat["a/new"] = np.ones(3, np.float32)     # added
+    new = encode_flat(new_flat, "f32")
+    entries = delta_encode(base, new)
+    assert "xi:r:a/w" in entries                  # sparse arm taken
+    same = json.loads(entries["__same__"].tobytes().decode())
+    assert "r:__norm_mean__" in same              # unchanged arm taken
+    rebuilt = delta_apply(base, entries)
+    assert rebuilt.keys() == new.keys()
+    assert all(rebuilt[k].tobytes() == new[k].tobytes() for k in new)
+
+
+def test_delta_composes_with_quantized_codec():
+    """A quantized delta reconstructs bitwise the quantized full frame
+    (deltas run over ENCODED bytes, so the oracle stays exact)."""
+    rng = np.random.default_rng(3)
+    f1 = _flat(rng)
+    f2 = {k: v.copy() for k, v in f1.items()}
+    f2["a/w"][0] += 0.25
+    for codec in ("bf16", "int8"):
+        e1, e2 = encode_flat(f1, codec), encode_flat(f2, codec)
+        rebuilt = delta_apply(e1, delta_encode(e1, e2))
+        assert rebuilt.keys() == e2.keys()
+        assert all(rebuilt[k].tobytes() == e2[k].tobytes() for k in e2)
+
+
+def test_delta_odd_byte_lengths():
+    """XOR word padding: dtypes whose nbytes aren't a multiple of 4."""
+    b = {"r:x": np.arange(7, dtype=np.uint8), "r:y": np.arange(3).astype(np.float16)}
+    n = {"r:x": np.arange(7, dtype=np.uint8) + 1,
+         "r:y": (np.arange(3) + 1).astype(np.float16)}
+    rebuilt = delta_apply(b, delta_encode(b, n))
+    assert all(rebuilt[k].tobytes() == n[k].tobytes() for k in n)
+    assert all(rebuilt[k].dtype == n[k].dtype for k in n)
+
+
+# ------------------------------------------------- server + client ----
+
+def test_full_then_delta_pull_and_memo_single_flight():
+    rng = np.random.default_rng(4)
+    store = WeightStore()
+    srv = WeightPlaneServer(store, window=4)
+    try:
+        store.publish(_params(rng), step=1, to_host=False)
+        clients = [WeightPlaneClient("127.0.0.1", srv.port, codec="f32")
+                   for _ in range(4)]
+        for c in clients:
+            v, params = c.get_if_newer()
+            assert v == 1 and params["meta"]["count"] == 7
+        stats = srv.weight_stats()
+        # 4 pullers, ONE encode + ONE frame build (single-flight memo)
+        assert stats["codec_encodes"] == 1
+        assert stats["frames_full"] == 4
+        store.publish(_params(rng), step=2, to_host=False)
+        for c in clients:
+            v, _ = c.get_if_newer()
+            assert v == 2 and c.counters["delta_frames"] == 1
+        assert srv.weight_stats()["frames_delta"] == 4
+        for c in clients:
+            assert c.get_if_newer() is None  # not newer
+            c.close()
+    finally:
+        srv.close()
+
+
+def test_quantized_transport_end_to_end():
+    rng = np.random.default_rng(5)
+    store = WeightStore()
+    srv = WeightPlaneServer(store, window=4)
+    try:
+        p = _params(rng)
+        store.publish(p, step=1, to_host=False)
+        for codec, tol in (("bf16", BF16_REL_BOUND), ("int8", 1.0 / 127)):
+            c = WeightPlaneClient("127.0.0.1", srv.port, codec=codec)
+            _, got = c.get_if_newer()
+            w, gw = p["actor"]["w0"], got["actor"]["w0"]
+            assert np.max(np.abs(gw - w)) <= tol * np.max(np.abs(w)) + 1e-6
+            assert got["meta"]["count"] == 7  # non-f32 stays exact
+            c.close()
+        assert srv.weight_stats()["oracle_quant_failures"] == 0
+        assert srv.weight_stats()["oracle_quant_checks"] >= 2
+    finally:
+        srv.close()
+
+
+def test_v1_client_against_plane_server():
+    """Dual protocol: the legacy WeightClient pulls from the plane
+    server unchanged (norm stats piggyback included)."""
+    rng = np.random.default_rng(6)
+    store = WeightStore()
+    srv = WeightPlaneServer(store, window=4)
+    try:
+        norm = (np.zeros(4), np.ones(4), 5.0)
+        store.publish(_params(rng), step=3, to_host=False, norm_stats=norm)
+        c = WeightClient("127.0.0.1", srv.port)
+        v, params = c.get_if_newer(0)
+        assert v == 1 and c.step == 3
+        assert c.norm_stats is not None and c.norm_stats[2] == 5.0
+        assert c.get_if_newer(v) is None
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_out_of_window_puller_falls_back_to_full():
+    rng = np.random.default_rng(7)
+    store = WeightStore()
+    srv = WeightPlaneServer(store, window=2)
+    try:
+        c = WeightPlaneClient("127.0.0.1", srv.port, codec="f32")
+        helper = WeightPlaneClient("127.0.0.1", srv.port, codec="f32")
+        store.publish(_params(rng), step=1, to_host=False)
+        assert c.get_if_newer()[0] == 1
+        # the window ingests versions AT SERVE TIME: pull each publish
+        # through a helper so v2..v4 enter the window and v1 ages out
+        for step in (2, 3, 4):
+            store.publish(_params(rng), step=step, to_host=False)
+            helper.get_if_newer()
+        assert c.get_if_newer()[0] == 4
+        assert c.counters["full_frames"] == 2  # base evicted -> full
+        assert c.counters["delta_frames"] == 0
+        helper.close()
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_torn_payload_rejected_never_accepted():
+    rng = np.random.default_rng(8)
+    store = WeightStore()
+    chaos = WeightWireChaos(torn_prob=1.0, seed=1)
+    srv = WeightPlaneServer(store, chaos=chaos)
+    try:
+        store.publish(_params(rng), step=1, to_host=False)
+        c = WeightPlaneClient("127.0.0.1", srv.port, reconnect_interval=0.01)
+        for _ in range(3):
+            assert c.get_if_newer() is None
+            time.sleep(0.02)
+        assert c.counters["torn_rejected"] >= 1
+        assert c.counters["accepts"] == 0
+        chaos.torn_prob = 0.0     # chaos off -> recovers on stale socket
+        assert _pull_until(c, 1)[0] == 1
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_generation_fence_client_rejects_pre_crash_frame():
+    rng = np.random.default_rng(9)
+    p = _params(rng)
+    store0 = WeightStore(generation=0)
+    srv0 = WeightPlaneServer(store0)
+    store0.publish(p, step=1, to_host=False)
+    store0.publish(p, step=2, to_host=False)
+    pre_crash = srv0.latest_full_payload()  # gen0 v2, genuine bytes
+    srv0.close()
+
+    store1 = WeightStore(generation=1)
+    chaos = WeightWireChaos(stale_prob=1.0, seed=2)
+    chaos.stash.append(pre_crash)
+    srv1 = WeightPlaneServer(store1, chaos=chaos)
+    try:
+        store1.publish(p, step=3, to_host=False)  # gen1 v1: version REWINDS
+        c = WeightPlaneClient("127.0.0.1", srv1.port)
+        c.generation = 1  # has seen gen1 (e.g. via a peer relay)
+        assert c.get_if_newer() is None  # injected gen0 v2: fenced
+        assert c.counters["fenced_rejected"] == 1
+        chaos.stale_prob = 0.0
+        res = c.get_if_newer()
+        assert res is not None and res[0] == 1
+        assert (c.generation, c.version) == (1, 1)
+        c.close()
+    finally:
+        srv1.close()
+
+
+def test_generation_bump_purges_server_window():
+    """The server drops every pre-crash window entry the moment it sees
+    a newer generation — a relay can never serve one as current."""
+    rng = np.random.default_rng(10)
+    store = WeightStore(generation=0)
+    srv = WeightPlaneServer(store, window=8)
+    try:
+        store.publish(_params(rng), step=1, to_host=False)
+        c = WeightPlaneClient("127.0.0.1", srv.port)
+        assert c.get_if_newer()[0] == 1
+        # simulate the relay's restart-adoption: same store jumps a gen
+        store.publish_versioned(_params(rng), version=1, step=9, generation=1)
+        v, _ = c.get_if_newer()
+        assert v == 1 and c.generation == 1
+        stats = srv.weight_stats()
+        assert stats["window_purged_generations"] == 1
+        assert stats["window_len"] == 1  # only the gen-1 entry survives
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_relay_chain_propagates_and_fences():
+    rng = np.random.default_rng(11)
+    p = _params(rng)
+    store = WeightStore(generation=0)
+    srv = WeightPlaneServer(store, window=4)
+    r1 = WeightRelay("127.0.0.1", srv.port, poll_interval=0.01, window=4)
+    r2 = WeightRelay("127.0.0.1", r1.port, poll_interval=0.01, window=4)
+    leaf = WeightPlaneClient("127.0.0.1", r2.port, codec="bf16")
+    try:
+        store.publish(p, step=1, to_host=False,
+                      norm_stats=(np.zeros(4), np.ones(4)))
+        res = _pull_until(leaf, 1)
+        assert res[0] == 1 and leaf.norm_stats is not None
+        # generation bump at the ROOT propagates through both hops and
+        # the version rewind is adopted, not fenced, at the leaf
+        store.publish_versioned(p, version=1, step=2, generation=1)
+        _pull_until(leaf, 1, want_gen=1)
+        assert (leaf.generation, leaf.version) == (1, 1)
+        assert r1.gen_adoptions >= 1 and r2.gen_adoptions >= 1
+    finally:
+        leaf.close()
+        r2.close()
+        r1.close()
+        srv.close()
+
+
+def test_plane_serve_traces_never_orphan():
+    from d4pg_tpu.obs.trace import RECORDER
+
+    rng = np.random.default_rng(12)
+    store = WeightStore()
+    srv = WeightPlaneServer(store)
+    RECORDER.reset()
+    RECORDER.enable(sample_rate=1.0)
+    try:
+        store.publish(_params(rng), step=1, to_host=False)
+        c = WeightPlaneClient("127.0.0.1", srv.port)
+        assert c.get_if_newer()[0] == 1          # commit terminal
+        store.publish(_params(rng), step=2, to_host=False)
+        # a delta frame against a base THIS client doesn't hold (a
+        # desynced/misbehaving server) must be shed, not applied
+        with srv._frame_lock:
+            srv._refresh_locked()
+            payload, _, _ = srv._frame_locked(0, 2, "f32", 1)
+        c.version = 0
+        assert c._accept(payload) is None        # base-miss -> shed
+        assert c.counters["delta_base_misses"] == 1
+        assert _pull_until(c, 2)[0] == 2         # full retry commits
+        c.close()
+        time.sleep(0.2)                          # teardown sweep settles
+        assert RECORDER.orphans() == []
+    finally:
+        RECORDER.disable()
+        RECORDER.reset()
+        srv.close()
+
+
+# ------------------------- satellite: v1 degradation + norm piggyback ----
+
+def test_v1_norm_stats_survive_reconnect_and_degradation():
+    """Norm-stats piggyback across a server restart: the client keeps
+    the last stats while degraded and refreshes them on the new
+    incarnation's first frame."""
+    rng = np.random.default_rng(13)
+    p = _params(rng)
+    store = WeightStore()
+    srv = WeightServer(store)
+    port = srv.port
+    store.publish(p, step=1, to_host=False,
+                  norm_stats=(np.zeros(3), np.ones(3), 5.0))
+    c = WeightClient("127.0.0.1", port, reconnect_interval=0.01)
+    v, _ = c.get_if_newer(0)
+    assert v == 1 and float(c.norm_stats[2]) == 5.0
+    srv.close()
+    assert c.get_if_newer(v) is None        # degraded: stale weights
+    assert c.norm_stats is not None         # ...and stale stats KEPT
+    # restarted server with refreshed stats on the same port
+    deadline = time.monotonic() + 10.0
+    while True:
+        try:
+            srv2 = WeightServer(store, port=port)
+            break
+        except OSError:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+    try:
+        store.publish(p, step=2, to_host=False,
+                      norm_stats=(np.ones(3), np.ones(3), 9.0))
+        deadline = time.monotonic() + 5.0
+        res = None
+        while res is None and time.monotonic() < deadline:
+            res = c.get_if_newer(v)
+            time.sleep(0.02)
+        assert res is not None and res[0] == 2
+        assert float(c.norm_stats[2]) == 9.0
+        c.close()
+    finally:
+        srv2.close()
+
+
+def test_v1_down_timeout_raises_and_flight_events():
+    from d4pg_tpu.obs.flight import RECORDER as FLIGHT
+
+    rng = np.random.default_rng(14)
+    store = WeightStore()
+    srv = WeightServer(store)
+    store.publish(_params(rng), step=1, to_host=False)
+    c = WeightClient("127.0.0.1", srv.port, down_timeout=0.2,
+                     reconnect_interval=0.01)
+    assert c.get_if_newer(0)[0] == 1
+    srv.close()
+    FLIGHT.reset()
+    assert c.get_if_newer(1) is None        # enters stale degradation
+    kinds = [e["kind"] for e in FLIGHT.events()]
+    assert "weight_stale_enter" in kinds
+    time.sleep(0.25)
+    with pytest.raises(ConnectionError, match="unreachable"):
+        c.get_if_newer(1)                   # past down_timeout: raises
+    c.close()
+    FLIGHT.reset()
+
+
+def test_v1_frame_memo_single_flight():
+    """Satellite 1: N pullers of one version cost ONE flatten+savez."""
+    rng = np.random.default_rng(15)
+    store = WeightStore()
+    srv = WeightServer(store)
+    try:
+        store.publish(_params(rng), step=1, to_host=False)
+        clients = [WeightClient("127.0.0.1", srv.port) for _ in range(5)]
+        for c in clients:
+            assert c.get_if_newer(0)[0] == 1
+        assert srv.frame_encodes == 1
+        store.publish(_params(rng), step=2, to_host=False)
+        for c in clients:
+            assert c.get_if_newer(1)[0] == 2
+            c.close()
+        assert srv.frame_encodes == 2
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------ chaos + gate ----
+
+@pytest.mark.fleet
+def test_weight_chaos_smoke():
+    """A small end-to-end chaos run must pass all three gating oracles
+    (plus the in-server delta/quant oracles) — the full-size version of
+    this run is the bench artifact's weights block."""
+    from d4pg_tpu.fleet.weight_chaos import WeightChaosConfig, run_weight_chaos
+
+    rep = run_weight_chaos(WeightChaosConfig(
+        n_pullers=8, relay_depth=2, duration_s=2.5,
+        learner_kills=1, relay_kills=1, seed=3))
+    assert rep["learner_kills"] == 1 and rep["final_generation"] == 1
+    assert rep["torn"]["accepted"] == 0
+    assert rep["ledger"]["monotone"] is True
+    assert rep["ledger"]["unpublished_accepted"] == 0
+    assert rep["trace"]["orphans"] == 0
+    assert rep["hierarchy_violations"] == 0
+    assert rep["oracle"]["delta_failures"] == 0
+    assert rep["oracle"]["quant_failures"] == 0
+    assert rep["frames_delta"] > 0 and rep["frames_full"] > 0
+    assert rep["snapshots_per_sec"] > 0
+
+
+@pytest.mark.obs
+def test_fleet_artifact_weights_schema():
+    """The newest committed fleet artifact must carry the weights block:
+    an N>=64 / relay-depth>=2 / >=1-learner-kill chaos run with
+    snapshots/s, delta hit-rate, staleness percentiles, and all three
+    oracles clean — a later PR that drops any of it fails tier-1 here."""
+    arts = sorted(glob.glob(os.path.join(
+        REPO_ROOT, "docs", "evidence", "fleet", "fleet_*.json")))
+    assert arts, "no committed fleet artifact"
+    with open(arts[-1]) as f:
+        artifact = json.load(f)
+    w = artifact.get("weights")
+    assert w, "newest fleet artifact lost its weights block"
+    assert w["metric"] == "weight_chaos" and w["schema"] == 1
+    assert w["n_pullers"] >= 64
+    assert w["relay_depth"] >= 2
+    assert w["learner_kills"] >= 1 and w["final_generation"] >= 1
+    assert w["snapshots_per_sec"] > 0
+    assert w["delta_hit_rate"] is not None and 0 < w["delta_hit_rate"] <= 1
+    for pct in ("p50", "p95", "p99"):
+        assert w["staleness_ms"][pct] is not None
+    assert w["torn"]["injected"] >= 1 and w["torn"]["accepted"] == 0
+    assert w["hierarchy_violations"] == 0
+    assert w["trace"]["orphans"] == 0
+    assert w["ledger"]["monotone"] is True
+    assert w["ledger"]["unpublished_accepted"] == 0
+    assert w["oracle"]["delta_failures"] == 0
+    assert w["oracle"]["quant_failures"] == 0
