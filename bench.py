@@ -442,6 +442,7 @@ def bench_fleet(ns=(8, 32, 64, 128, 256), duration_s: float = 10.0,
     from d4pg_tpu.fleet.chaos import ChaosConfig
     from d4pg_tpu.fleet.sweep import (
         default_chaos,
+        run_learners,
         run_recovery,
         run_sweep,
         run_weights,
@@ -479,6 +480,15 @@ def bench_fleet(ns=(8, 32, 64, 128, 256), duration_s: float = 10.0,
     artifact["weights"] = run_weights(
         n_pullers=max(64, min(ns)), relay_depth=2,
         duration_s=duration_s, seed=seed, learner_kills=1)
+    # multi-learner block: updates/s vs replica count (kill-free rows
+    # with staleness percentiles + correction-clip rate per N), then one
+    # learner-chaos run at N=4 with seeded replica kills — replayed
+    # in-flight frames must bounce off the dead epoch and the published
+    # (generation, version) ledger must never rewind. Schema-checked in
+    # tier-1 (tests/test_learner_plane.py) like the blocks above.
+    artifact["learners"] = run_learners(
+        ns=(1, 2, 4), duration_s=min(duration_s, 4.0), seed=seed,
+        replica_kills=2)
     return artifact
 
 

@@ -81,6 +81,22 @@ class ExperimentConfig:
     # Composes with data_parallel (batches sharded P(None, 'data')).
     # 1 = exact reference dispatch semantics (write-back every step).
     updates_per_dispatch: int = 40
+    # Multi-learner plane (learner/replica.py + learner/aggregator.py):
+    # N replicas each own a full D4PGState (their OWN optimizer state and
+    # PRNG key) and sample the shared ReplayService concurrently; an
+    # aggregator merges their version-stamped updates into the ONE
+    # WeightStore stream with IMPACT-style staleness weighting (arXiv
+    # 1912.00167). 1 = the legacy fused single-learner loop (same code:
+    # both paths drive learner/loop.FusedLoop). N > 1 requires the
+    # host-sampled replay path (fused device replay is single-consumer).
+    learners: int = 1  # --learners
+    # 'async': clipped importance-weighted staleness correction, no
+    # barrier; 'sync': plain N-way averaging barrier per round
+    agg_mode: str = "async"
+    # staleness-weight clip: a stale update's weight is
+    # max(1/(1+lag), 1/agg_clip) — the floor keeps a lagging replica's
+    # vote bounded away from zero (>= 1; higher tolerates more staleness)
+    agg_clip: float = 8.0
     # algorithm
     gamma: float = 0.99  # --gamma
     tau: float = 0.001  # --tau
@@ -413,6 +429,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "learner records per-stage latency histograms for "
                         "frames remote actors sample at this rate over "
                         "the raw codec (0 = off)")
+    p.add_argument("--learners", type=int, default=d.learners,
+                   help="learner replicas: N>1 runs each on its own "
+                        "thread against the shared replay service, with "
+                        "an aggregator merging their updates into the "
+                        "single versioned weight stream (1 = legacy "
+                        "fused single-learner loop)")
+    p.add_argument("--agg_mode", choices=("async", "sync"),
+                   default=d.agg_mode,
+                   help="update aggregation: 'async' = IMPACT-style "
+                        "clipped staleness-weighted correction, 'sync' = "
+                        "N-way averaging barrier")
+    p.add_argument("--agg_clip", type=float, default=d.agg_clip,
+                   help="staleness-weight clip (async mode): a stale "
+                        "update's weight is max(1/(1+lag), 1/clip)")
     p.add_argument("--profile_dir", default=d.profile_dir)
     p.add_argument("--log_dir", default=d.log_dir)
     p.add_argument("--seed", type=int, default=d.seed)

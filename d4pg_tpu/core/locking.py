@@ -52,6 +52,15 @@ from d4pg_tpu.obs.registry import REGISTRY as _obs_registry
 HIERARCHY: dict[str, int] = {
     "service": 50,  # ReplayService._lock (heartbeats, pending, env_steps)
     "buffer": 40,   # ReplayService._buffer_lock (all replay-state access)
+    # Multi-learner plane (replica -> aggregator -> store): a replica may
+    # hold its control lock while submitting to the aggregator
+    # (replica -> agg descends), and the aggregator publishes merged
+    # params into the WeightStore while holding its own condition
+    # (agg -> wstore descends). A replica must NEVER hold its lock
+    # across replay sampling — buffer(40) sits ABOVE replica(36), so the
+    # sentinels catch that inversion at the first acquisition.
+    "replica": 36,  # LearnerReplica._replica_lock (epoch, counters, flags)
+    "agg": 34,      # Aggregator._agg_cond (merge state + sync barrier)
     "commit": 30,   # ReplayService._commit_cond (ordered-merge state)
     # Weight-distribution plane (learner -> actors; disjoint from the
     # ingest tiers above, so its band sits between commit and the leaf

@@ -1,0 +1,330 @@
+"""Replica→aggregator update transport: the multi-learner wire plane.
+
+The third wire plane (after transitions in and weights out): a learner
+replica ships its post-round params — stamped with the **basis version**
+it computed against, its **epoch**, and the **store generation** it
+believes is live — to the process that owns the ``Aggregator``, and gets
+back the merge verdict (applied/fenced, new version, staleness weight).
+
+Frame layout (request, client → server):
+
+  [u32 0xD4AB][u32 replica][u32 epoch][u32 generation]
+  [i64 basis_version][i64 step][i64 trace_id][f64 birth_ts]
+  [u8 codec][u32 crc32][u32 len][payload]
+
+payload = npz of the flattened param tree run through the weight
+plane's v2 codec (``weight_plane.encode_flat``: raw f32 / bf16 / int8 —
+the replica chooses per client, exactly like a weight puller does). The
+crc32 covers the payload: a torn frame is detected, counted, shed —
+never merged.
+
+**Zero-decode fencing**: everything the server needs to fence a dead
+replica's in-flight update — replica id, epoch, generation — travels in
+the fixed 57-byte header (``update_frame_meta``), so a frame from a
+fenced epoch is rejected before paying npz decode or crc over a
+multi-MB payload. That is the replica-kill chaos hot path: kill fires
+``Aggregator.fence_replica`` and the victim's last frame, replayed
+verbatim, must bounce off the header check.
+
+Ack (server → client):
+
+  [u32 0xD4AB][u8 status][i64 version][i64 lag][f64 weight][u8 clipped]
+
+status: 0 applied, 1 fenced, 2 torn (crc/format), 3 barrier timeout.
+
+Tracing: when the recorder is armed, a sampled submit opens a span at
+the replica (birth = encode instant), the server records ``admission``
+on receipt and ``decode`` after the payload round-trip, then terminates
+it: ``commit`` when the merge applies, ``shed`` when fenced or torn —
+the same zero-orphan contract as the ingest and weight planes.
+"""
+
+from __future__ import annotations
+
+import io
+import socket
+import struct
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from d4pg_tpu.distributed.transport import (
+    MAX_PAYLOAD,
+    ConnRegistry,
+    ProtocolError,
+    ReconnectingClient,
+    _recv_exact,
+    server_handshake,
+)
+from d4pg_tpu.distributed.weight_plane import decode_flat, encode_flat
+from d4pg_tpu.distributed.weight_server import _flatten, _unflatten
+from d4pg_tpu.obs.flight import record_event
+from d4pg_tpu.obs.trace import RECORDER as TRACE, new_trace_id
+
+_UPD_MAGIC = 0xD4AB
+_UPD_HDR = struct.Struct("!IIIIqqqdBII")
+_UPD_ACK = struct.Struct("!IBqqdB")
+
+STATUS_APPLIED = 0
+STATUS_FENCED = 1
+STATUS_TORN = 2
+STATUS_TIMEOUT = 3
+_STATUS_NAMES = {STATUS_APPLIED: "applied", STATUS_FENCED: "fenced",
+                 STATUS_TORN: "torn", STATUS_TIMEOUT: "barrier_timeout"}
+_STATUS_IDS = {v: k for k, v in _STATUS_NAMES.items()}
+
+
+# ------------------------------------------------------------- codec ----
+
+def encode_update(params, *, replica_id: int, epoch: int, generation: int,
+                  basis_version: int, step: int = 0, codec: str = "f32",
+                  trace_id: int = 0, birth_ts: float | None = None) -> bytes:
+    """One wire frame for a replica submission (see module doc)."""
+    flat = encode_flat(_flatten(params), codec)
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    payload = buf.getvalue()
+    if len(payload) > MAX_PAYLOAD:
+        raise ProtocolError(
+            f"update payload {len(payload)}B exceeds MAX_PAYLOAD")
+    header = _UPD_HDR.pack(
+        _UPD_MAGIC, int(replica_id), int(epoch), int(generation),
+        int(basis_version), int(step), int(trace_id),
+        time.time() if birth_ts is None else float(birth_ts),
+        ("f32", "bf16", "int8").index(codec),
+        zlib.crc32(payload), len(payload))
+    return header + payload
+
+
+def update_frame_meta(frame: bytes) -> dict:
+    """Header-only parse — the zero-decode fencing read. Validates magic
+    and length bounds but deliberately NOT the crc (that would require
+    touching the whole payload, defeating the point)."""
+    if len(frame) < _UPD_HDR.size:
+        raise ProtocolError(f"update frame truncated at {len(frame)}B")
+    (magic, replica_id, epoch, generation, basis_version, step, trace_id,
+     birth_ts, codec_id, crc, length) = _UPD_HDR.unpack_from(frame)
+    if magic != _UPD_MAGIC:
+        raise ProtocolError(f"bad update magic {magic:#x}")
+    if length > MAX_PAYLOAD:
+        raise ProtocolError(f"update payload {length}B exceeds MAX_PAYLOAD")
+    return {"replica_id": replica_id, "epoch": epoch,
+            "generation": generation, "basis_version": basis_version,
+            "step": step, "trace_id": trace_id, "birth_ts": birth_ts,
+            "codec": ("f32", "bf16", "int8")[codec_id], "crc": crc,
+            "len": length}
+
+
+def decode_update(frame: bytes):
+    """(meta, params) — crc-checked full decode; raises ``ProtocolError``
+    on a torn or corrupt payload."""
+    meta = update_frame_meta(frame)
+    payload = frame[_UPD_HDR.size:]
+    if len(payload) != meta["len"]:
+        raise ProtocolError(
+            f"update payload torn: {len(payload)}B of {meta['len']}B")
+    if zlib.crc32(payload) != meta["crc"]:
+        raise ProtocolError("update payload crc mismatch")
+    with np.load(io.BytesIO(payload)) as z:
+        flat = {k: z[k] for k in z.files}
+    return meta, _unflatten(decode_flat(flat))
+
+
+# ------------------------------------------------------------- server ----
+
+class AggregatorServer(ConnRegistry):
+    """Accepts replica connections and feeds their frames to an
+    ``Aggregator``. One thread per connection (replica counts are small —
+    single digits — so a thread per replica is the simple right thing);
+    each submit is a strict request/ack round trip, which doubles as
+    replica-side backpressure: a replica cannot run ahead of its own
+    unmerged update."""
+
+    def __init__(self, agg, host: str = "127.0.0.1", port: int = 0,
+                 secret: str | None = None):
+        super().__init__()
+        self._agg = agg
+        self._secret = secret
+        self.frames = 0
+        self.applied = 0
+        self.fenced_header = 0   # zero-decode header fences
+        self.fenced_submit = 0   # aggregator-level fences
+        self.torn = 0
+        self.bytes_in = 0
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, port))
+        self._server.listen()
+        self.port = self._server.getsockname()[1]
+        self._stop = threading.Event()
+        self._conn_threads: list[threading.Thread] = []
+        self._thread = threading.Thread(target=self._accept, daemon=True)
+        self._thread.start()
+
+    def _accept(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._server.settimeout(0.2)
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            self._register_conn(conn)
+            self._conn_threads = [t for t in self._conn_threads
+                                  if t.is_alive()]
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            self._conn_threads.append(t)
+            t.start()
+
+    def _handle_frame(self, frame: bytes) -> tuple[int, dict]:
+        """(status_id, result) for one complete frame — shared by the
+        socket path and tests that drive raw bytes."""
+        self.frames += 1
+        self.bytes_in += len(frame)
+        meta = update_frame_meta(frame)
+        tid = meta["trace_id"]
+        if tid:
+            TRACE.begin(tid, meta["birth_ts"])
+            TRACE.record_span(tid, "admission")
+        live = self._agg.live_epoch(meta["replica_id"])
+        if live != meta["epoch"]:
+            # the chaos hot path: dead epoch bounced off the header,
+            # payload never decoded
+            self.fenced_header += 1
+            if tid:
+                TRACE.terminal_shed(tid)
+            record_event("update_header_fenced", replica=meta["replica_id"],
+                         epoch=meta["epoch"], live_epoch=live)
+            return STATUS_FENCED, {"version": self._agg.version}
+        try:
+            meta, params = decode_update(frame)
+        except ProtocolError:
+            self.torn += 1
+            if tid:
+                TRACE.terminal_shed(tid)
+            record_event("update_torn", replica=meta["replica_id"])
+            return STATUS_TORN, {"version": self._agg.version}
+        if tid:
+            TRACE.record_span(tid, "decode")
+        result = self._agg.submit(
+            meta["replica_id"], meta["epoch"], params,
+            meta["basis_version"], step=meta["step"],
+            generation=meta["generation"])
+        status = _STATUS_IDS.get(result["status"], STATUS_FENCED)
+        if status == STATUS_APPLIED:
+            self.applied += 1
+            if tid:
+                TRACE.mark_committed([tid])
+        else:
+            if status == STATUS_FENCED:
+                self.fenced_submit += 1
+            if tid:
+                TRACE.terminal_shed(tid)
+        return status, result
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                if not server_handshake(conn, self._secret):
+                    return
+                while not self._stop.is_set():
+                    head = _recv_exact(conn, _UPD_HDR.size)
+                    if head is None:
+                        return
+                    meta = update_frame_meta(head)
+                    payload = _recv_exact(conn, meta["len"])
+                    if payload is None:
+                        return  # peer died mid-frame: TCP tears it for us
+                    status, result = self._handle_frame(head + payload)
+                    lag = result.get("lag")
+                    conn.sendall(_UPD_ACK.pack(
+                        _UPD_MAGIC, status, int(result.get("version", 0)),
+                        -1 if lag is None else int(lag),
+                        float(result.get("weight", 0.0)),
+                        int(bool(result.get("clipped", False)))))
+        except (OSError, ProtocolError):
+            return  # conn-level fault: drop the connection, replica retries
+        finally:
+            self._unregister_conn(conn)
+
+    def stats(self) -> dict:
+        return {"frames": self.frames, "applied": self.applied,
+                "fenced_header": self.fenced_header,
+                "fenced_submit": self.fenced_submit, "torn": self.torn,
+                "bytes_in": self.bytes_in}
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        self._shutdown_conns()
+        for t in self._conn_threads:
+            t.join(timeout=2.0)
+        self._conn_threads.clear()
+
+
+# ------------------------------------------------------------- client ----
+
+class UpdateClient(ReconnectingClient):
+    """Replica-side submitter. ``submit`` matches the in-process
+    ``Aggregator.submit`` verdict shape, so a ``LearnerReplica`` can use
+    either interchangeably (``basis``/``register`` stay in-process —
+    replicas and aggregator share the train process today; this client
+    exists for the chaos harness and the eventual cross-host learner).
+
+    The last encoded frame is retained (``last_frame``) so a supervisor
+    can replay a killed replica's in-flight bytes verbatim — the chaos
+    harness's fence-must-bounce probe."""
+
+    def __init__(self, host: str, port: int, connect_timeout: float = 10.0,
+                 secret: str | None = None, codec: str = "f32"):
+        self.codec = codec
+        self.last_frame: bytes | None = None
+        self.acks = 0
+        super().__init__(host, port, connect_timeout=connect_timeout,
+                         secret=secret)
+
+    def submit(self, replica_id: int, epoch: int, params, basis_version: int,
+               step: int = 0, generation: int = 0,
+               trace_id: int | None = None) -> dict:
+        if trace_id is None:
+            # birth_ts in the header carries the send instant; the span
+            # itself opens server-side at admission (weight-plane idiom)
+            trace_id = new_trace_id(replica_id) if TRACE.enabled else 0
+        frame = encode_update(
+            params, replica_id=replica_id, epoch=epoch,
+            generation=generation, basis_version=basis_version, step=step,
+            codec=self.codec, trace_id=trace_id)
+        self.last_frame = frame
+        return self.submit_frame(frame)
+
+    def submit_frame(self, frame: bytes) -> dict:
+        """Ship raw frame bytes (the chaos replay path) and await the
+        ack. Transport faults raise ``ConnectionError`` — the caller
+        (supervisor) owns the respawn policy."""
+        self._check_open()
+        with self._lock:
+            if self._sock is None:
+                self._connect()
+            try:
+                self._sock.sendall(frame)
+                ack = _recv_exact(self._sock, _UPD_ACK.size)
+            except OSError as e:
+                self._drop_sock()
+                raise ConnectionError(f"update submit failed: {e}") from e
+            if ack is None:
+                self._drop_sock()
+                raise ConnectionError("aggregator closed during submit")
+        magic, status, version, lag, weight, clipped = _UPD_ACK.unpack(ack)
+        if magic != _UPD_MAGIC:
+            raise ProtocolError(f"bad ack magic {magic:#x}")
+        self.acks += 1
+        return {"status": _STATUS_NAMES.get(status, "fenced"),
+                "version": version, "lag": None if lag < 0 else lag,
+                "weight": weight, "clipped": bool(clipped)}

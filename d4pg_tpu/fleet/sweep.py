@@ -326,6 +326,48 @@ def run_weights(
         seed=int(seed), **overrides))
 
 
+def run_learners(
+    ns=(1, 2, 4),
+    duration_s: float = 4.0,
+    seed: int = 0,
+    replica_kills: int = 2,
+    mode: str = "async",
+    **overrides,
+) -> dict:
+    """The bench_fleet learners block (``fleet/learner_chaos.py``):
+    updates/s vs replica count from kill-free rows (the scaling story —
+    staleness percentiles and correction-clip rate per N), then ONE
+    chaos row at N=max(ns) with seeded replica kills — in-flight-frame
+    fencing, ledger monotonicity, trace orphans and the lock hierarchy
+    are its run-gating oracles."""
+    from d4pg_tpu.fleet.learner_chaos import (
+        LearnerChaosConfig,
+        run_learner_chaos,
+    )
+
+    sweep = []
+    for n in ns:
+        r = run_learner_chaos(LearnerChaosConfig(
+            n_replicas=int(n), duration_s=float(duration_s),
+            replica_kills=0, torn_prob=0.0, mode=mode, seed=int(seed),
+            **overrides))
+        sweep.append({
+            "n_replicas": int(n),
+            "updates_per_sec": r["updates_per_sec"],
+            "staleness": r["staleness"],
+            "clip_rate": r["clip_rate"],
+            "ledger_monotone": r["ledger"]["monotone"],
+            "trace_orphans": r["trace"]["orphans"],
+            "hierarchy_violations": r["hierarchy_violations"],
+        })
+    chaos_row = run_learner_chaos(LearnerChaosConfig(
+        n_replicas=int(max(ns)), duration_s=float(duration_s),
+        replica_kills=int(replica_kills), mode=mode, seed=int(seed),
+        **overrides))
+    return {"metric": "fleet_learners", "schema": 1, "mode": mode,
+            "sweep": sweep, "chaos": chaos_row, "seed": int(seed)}
+
+
 def _lock_wait_ms(row: dict) -> float | None:
     """Total contended-acquisition wait across every tiered lock."""
     locks = row.get("locks")
@@ -378,6 +420,10 @@ def main(argv=None):
                          "N pullers over a relay tree, torn/stale/kill "
                          "faults) instead of the ingest sweep")
     ap.add_argument("--relay_depth", type=int, default=2)
+    ap.add_argument("--learners", type=int, nargs="+", default=None,
+                    help="run the multi-learner block instead: updates/s "
+                         "vs these replica counts + one replica-kill "
+                         "chaos row (fleet/learner_chaos.py)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--no_chaos", action="store_true",
                     help="clean-plane control run (all fault probs 0)")
@@ -386,7 +432,12 @@ def main(argv=None):
     ns = ap.parse_args(argv)
     chaos = (ChaosConfig(seed=ns.seed) if ns.no_chaos
              else default_chaos(ns.seed))
-    if ns.weights:
+    if ns.learners:
+        artifact = run_learners(
+            ns=tuple(ns.learners), duration_s=ns.seconds, seed=ns.seed,
+            **({"replica_kills": 0, "torn_prob": 0.0}
+               if ns.no_chaos else {}))
+    elif ns.weights:
         artifact = run_weights(
             n_pullers=max(ns.ns), relay_depth=ns.relay_depth,
             duration_s=ns.seconds, seed=ns.seed,

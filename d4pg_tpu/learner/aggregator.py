@@ -1,0 +1,354 @@
+"""Gradient/update aggregator: N learner replicas, ONE versioned stream.
+
+The multi-learner plane's merge point (ROADMAP direction #1, IMPACT —
+arXiv 1912.00167). Each ``LearnerReplica`` computes updates against a
+**basis version** it pulled from here, stamps that version on its
+submission, and the aggregator merges the result into the single
+authoritative parameter tree, publishing every merge through the
+versioned ``WeightStore`` so actors, relays and the whole PR-9 weight
+plane keep seeing one monotone (generation, version) stream — replicas
+are invisible downstream.
+
+Two aggregation modes (config choice, not architecture — the
+"21 minutes" paper's synchronous alternative, arXiv 1801.02852):
+
+- ``async`` (IMPACT-style): a submission computed against basis version
+  ``b`` arriving when the aggregate is at version ``v`` has staleness
+  ``lag = v - b``. It is applied as an importance-weighted correction
+
+      params <- params + w * (submitted - params),
+      w = max(1 / (1 + lag), 1 / clip)
+
+  i.e. the natural ``1/(1+lag)`` staleness discount, clipped from
+  below at ``1/clip`` so a very stale (but live) replica keeps a
+  bounded vote instead of starving (``clip >= 1``, configurable; the
+  **clip rate** — how often the bound engages — is exported). At
+  ``lag == 0`` the submission IS the next aggregate and is adopted
+  wholesale — an exact identity fast-path, NOT ``params + 1.0 *
+  (new - params)``, whose float round-trip would break the N=1
+  bitwise-equivalence oracle the tier-1 suite pins.
+
+- ``sync``: a plain N-way averaging barrier. Submissions accumulate
+  until every live replica has contributed, the trees are averaged
+  (sole contributor: adopted exactly), published once, and all waiters
+  release. A replica fenced mid-round is dropped from the barrier so a
+  kill never wedges the survivors.
+
+**Fencing** (the PR-7 idiom at replica granularity): every replica is
+registered with an **epoch**; ``fence_replica`` bumps it, so an
+in-flight update from a killed replica — stamped with the dead epoch —
+is counted and discarded on arrival, never applied. The published
+version stream cannot rewind: versions come from ``WeightStore.publish``
+(monotone by construction) and the ledger oracle double-checks it.
+
+Locking: everything lives under ONE declared-tier condition
+(``agg`` = 34 > ``wstore`` = 24 — publishing while holding it descends;
+a replica may hold its ``replica``-tier lock while submitting). The
+aggregator registers the obs registry's ``learner`` provider:
+per-replica lag/epoch/fence tallies, clip rate, staleness percentiles.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from d4pg_tpu.core.locking import TieredCondition
+from d4pg_tpu.obs.flight import record_event
+from d4pg_tpu.obs.registry import REGISTRY, percentile_summary
+
+_tree_map = jax.tree_util.tree_map
+
+MODES = ("async", "sync")
+
+
+def _blend(cur: np.ndarray, new: np.ndarray, w: float) -> np.ndarray:
+    """One leaf of the stale-update correction, dtype-preserving."""
+    cur = np.asarray(cur)
+    out = cur + np.asarray(w, dtype=np.float32) * (np.asarray(new) - cur)
+    return out.astype(cur.dtype, copy=False)
+
+
+class Aggregator:
+    """Merges per-replica updates into one versioned ``WeightStore``.
+
+    ``extract`` maps the merged tree to what the store publishes (e.g.
+    ``lambda t: t["actor"]`` when replicas submit actor+critic trees —
+    actors only pull acting params); default publishes the whole tree.
+    ``norm_stats`` is the optional obs-normalizer snapshot hook the
+    legacy publish path threads through (``train._norm_snapshot``)."""
+
+    def __init__(
+        self,
+        store,
+        *,
+        mode: str = "async",
+        clip: float = 8.0,
+        extract: Optional[Callable[[Any], Any]] = None,
+        norm_stats: Optional[Callable[[], tuple | None]] = None,
+        sync_timeout: float = 30.0,
+    ):
+        if mode not in MODES:
+            raise ValueError(f"unknown aggregation mode {mode!r}")
+        if clip < 1.0:
+            raise ValueError(
+                f"clip={clip} would weight stale updates ABOVE fresh ones; "
+                "the bound is a floor 1/clip <= 1, so clip >= 1")
+        self._store = store
+        self.mode = mode
+        self.clip = float(clip)
+        self._extract = extract
+        self._norm_stats = norm_stats
+        self._sync_timeout = float(sync_timeout)
+        self._agg_cond = TieredCondition("agg")
+        # -- merge state (all under _agg_cond) ------------------------------
+        self._params: Any = None
+        self._version = int(getattr(store, "version", 0))
+        self._step = 0
+        self._epochs: dict[int, int] = {}       # live epoch per replica
+        self._next_epoch: dict[int, int] = {}   # monotone per replica id
+        self._per_replica: dict[int, dict] = {}
+        self._lags: deque = deque(maxlen=4096)
+        self._applied = 0
+        self._fenced = 0
+        self._clipped = 0
+        self._ledger: list[tuple[int, int]] = []  # published (gen, version)
+        # -- sync barrier ----------------------------------------------------
+        self._round: dict[int, tuple] = {}       # id -> (params, basis, step)
+        self._round_seq = 0
+        self._sync_results: dict[int, dict] = {}
+        REGISTRY.register_provider("learner", self._snapshot)
+
+    # -- replica lifecycle ---------------------------------------------------
+    def register(self, replica_id: int, params: Any = None,
+                 step: int = 0) -> int:
+        """Admit (or re-admit after a kill) a replica; returns its live
+        epoch. The FIRST registration may seed the aggregate with the
+        replica's initial params (version 0 basis) so ``basis()`` has
+        something to serve before any submit lands."""
+        with self._agg_cond:
+            epoch = self._next_epoch.get(replica_id, 0) + 1
+            self._next_epoch[replica_id] = epoch
+            self._epochs[replica_id] = epoch
+            stats = self._per_replica.setdefault(
+                replica_id, {"submits": 0, "fenced": 0, "lag": None,
+                             "weight": None, "last_version": 0})
+            stats["epoch"] = epoch
+            if params is not None and self._params is None:
+                self._params = params
+                self._step = int(step)
+            self._maybe_complete_round_locked()
+            self._agg_cond.notify_all()
+            return epoch
+
+    def fence_replica(self, replica_id: int) -> None:
+        """Kill-path fence: bump the replica out of its epoch so any
+        in-flight contribution it had on the wire is discarded on
+        arrival (counted, never applied), and drop it from a pending
+        sync barrier so the survivors' round can complete."""
+        with self._agg_cond:
+            self._epochs.pop(replica_id, None)
+            self._round.pop(replica_id, None)
+            record_event("replica_fenced", replica=replica_id)
+            self._maybe_complete_round_locked()
+            self._agg_cond.notify_all()
+
+    def live_epoch(self, replica_id: int) -> Optional[int]:
+        """The replica's live epoch, or None once fenced — the wire
+        server's zero-decode header check reads this before paying for
+        payload decode."""
+        with self._agg_cond:
+            return self._epochs.get(replica_id)
+
+    # -- basis pulls ---------------------------------------------------------
+    def current(self) -> tuple[int, Any]:
+        """(version, merged params) — params None before any seed."""
+        with self._agg_cond:
+            return self._version, self._params
+
+    def basis(self, replica_id: int) -> tuple[int, Any]:
+        """The basis a replica should compute its next update against.
+        Returns ``(version, params)`` with ``params=None`` when nothing
+        newer than the replica's OWN last applied submission exists —
+        the sole-replica case, where re-adopting its own round-tripped
+        params would break bitwise equivalence with the legacy loop."""
+        with self._agg_cond:
+            stats = self._per_replica.get(replica_id)
+            last = stats["last_version"] if stats else 0
+            if self._params is None or self._version <= last:
+                return self._version, None
+            return self._version, self._params
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, replica_id: int, epoch: int, params: Any,
+               basis_version: int, step: int = 0,
+               generation: int | None = None) -> dict:
+        """Merge one replica update computed against ``basis_version``.
+        Returns ``{"status": "applied"|"fenced", "version", "lag",
+        "weight", "clipped"}`` (sync mode blocks until the barrier
+        round completes or times out)."""
+        with self._agg_cond:
+            stats = self._per_replica.setdefault(
+                replica_id, {"submits": 0, "fenced": 0, "lag": None,
+                             "weight": None, "last_version": 0})
+            live = self._epochs.get(replica_id)
+            if live != epoch or (generation is not None and
+                                 generation != self._store.generation):
+                self._fenced += 1
+                stats["fenced"] += 1
+                record_event("update_fenced", replica=replica_id,
+                             epoch=epoch, live_epoch=live)
+                return {"status": "fenced", "version": self._version,
+                        "lag": None, "weight": 0.0, "clipped": False}
+            lag = self._version - int(basis_version)
+            if lag < 0:
+                # basis from the future: protocol breach (a replica can
+                # only have pulled a version this aggregator published)
+                self._fenced += 1
+                stats["fenced"] += 1
+                return {"status": "fenced", "version": self._version,
+                        "lag": lag, "weight": 0.0, "clipped": False}
+            if self.mode == "sync":
+                return self._submit_sync_locked(
+                    replica_id, params, lag, step, stats)
+            raw_w = 1.0 / (1.0 + lag)
+            w = max(raw_w, 1.0 / self.clip)
+            clipped = raw_w < w
+            if clipped:
+                self._clipped += 1
+            if lag == 0 or self._params is None:
+                # exact identity fast-path (bitwise — see module doc)
+                self._params = params
+            else:
+                self._params = _tree_map(
+                    lambda c, n: _blend(c, n, w), self._params, params)
+            self._step = int(step)
+            version = self._publish_locked()
+            self._applied += 1
+            self._lags.append(float(lag))
+            stats["submits"] += 1
+            stats["lag"] = lag
+            stats["weight"] = round(w, 6)
+            stats["last_version"] = version
+            return {"status": "applied", "version": version, "lag": lag,
+                    "weight": w, "clipped": clipped}
+
+    def _submit_sync_locked(self, replica_id: int, params: Any, lag: int,
+                            step: int, stats: dict) -> dict:
+        self._round[replica_id] = (params, lag, int(step))
+        seq = self._round_seq
+        self._maybe_complete_round_locked()
+        deadline_ok = self._agg_cond.wait_for(
+            lambda: self._round_seq != seq
+            or self._epochs.get(replica_id) is None,
+            timeout=self._sync_timeout)
+        if self._epochs.get(replica_id) is None:
+            self._fenced += 1
+            stats["fenced"] += 1
+            return {"status": "fenced", "version": self._version,
+                    "lag": lag, "weight": 0.0, "clipped": False}
+        if not deadline_ok:
+            # leave the contribution staged; a late barrier can still
+            # complete it, but this caller reports the stall
+            return {"status": "barrier_timeout", "version": self._version,
+                    "lag": lag, "weight": 0.0, "clipped": False}
+        return self._sync_results.pop(replica_id)
+
+    def _maybe_complete_round_locked(self) -> None:
+        if (self.mode != "sync" or not self._epochs
+                or not self._round
+                or set(self._round) < set(self._epochs)):
+            return
+        contributions = [self._round[rid] for rid in sorted(self._round)]
+        n = len(contributions)
+        if n == 1:
+            merged = contributions[0][0]  # sole contributor: exact
+        else:
+            merged = _tree_map(
+                lambda *leaves: (
+                    np.sum(np.stack([np.asarray(x) for x in leaves], 0),
+                           axis=0, dtype=np.float64) / n
+                ).astype(np.asarray(leaves[0]).dtype),
+                *[c[0] for c in contributions])
+        self._params = merged
+        self._step = max(c[2] for c in contributions)
+        version = self._publish_locked()
+        self._applied += n
+        w = 1.0 / n
+        for rid in list(self._round):
+            _params, lag, _step = self._round.pop(rid)
+            st = self._per_replica[rid]
+            st["submits"] += 1
+            st["lag"] = lag
+            st["weight"] = round(w, 6)
+            st["last_version"] = version
+            self._lags.append(float(lag))
+            self._sync_results[rid] = {
+                "status": "applied", "version": version, "lag": lag,
+                "weight": w, "clipped": False}
+        self._round_seq += 1
+        self._agg_cond.notify_all()
+
+    def _publish_locked(self) -> int:
+        pub = self._extract(self._params) if self._extract else self._params
+        norm = self._norm_stats() if self._norm_stats else None
+        # holding _agg_cond (34) while taking _store_lock (24): descends
+        version = self._store.publish(pub, step=self._step, to_host=False,
+                                      norm_stats=norm)
+        self._version = version
+        self._ledger.append((self._store.generation, version))
+        return version
+
+    # -- oracles / obs -------------------------------------------------------
+    @property
+    def version(self) -> int:
+        with self._agg_cond:
+            return self._version
+
+    def ledger(self) -> list[tuple[int, int]]:
+        with self._agg_cond:
+            return list(self._ledger)
+
+    def ledger_monotone(self) -> bool:
+        """The never-rewinds oracle: across everything this aggregator
+        ever published, generation never decreases and version strictly
+        increases within a generation."""
+        prev = (-1, -1)
+        for gen, version in self.ledger():
+            if gen < prev[0] or (gen == prev[0] and version <= prev[1]):
+                return False
+            prev = (gen, version)
+        return True
+
+    def counters(self) -> dict:
+        with self._agg_cond:
+            return {"applied": self._applied, "fenced": self._fenced,
+                    "clipped": self._clipped,
+                    "published": len(self._ledger)}
+
+    def _snapshot(self) -> dict:
+        """obs registry ``learner`` provider: per-replica lag + fence
+        tallies, clip rate, staleness percentiles. Same consistency
+        contract as every provider — one pass under the owner's lock."""
+        with self._agg_cond:
+            applied = self._applied
+            return {
+                "mode": self.mode,
+                "clip": self.clip,
+                "version": self._version,
+                "replicas": {
+                    str(rid): dict(stats)
+                    for rid, stats in self._per_replica.items()},
+                "live_replicas": len(self._epochs),
+                "applied": applied,
+                "fenced": self._fenced,
+                "clip_rate": (round(self._clipped / applied, 4)
+                              if applied else 0.0),
+                "staleness": percentile_summary(list(self._lags)),
+            }
+
+    def close(self) -> None:
+        REGISTRY.unregister_provider("learner", self._snapshot)

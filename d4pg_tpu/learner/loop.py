@@ -1,0 +1,137 @@
+"""The fused training loop, extracted from ``train.py``.
+
+One class owns the commit -> dispatch -> stage schedule that used to
+live inline in ``train.train_steps_fused`` so the legacy single-learner
+path and the multi-learner ``LearnerReplica`` (``learner/replica.py``)
+run the SAME implementation instead of a fork — which is what makes the
+N=1-replica ⇔ legacy-loop bitwise-equivalence oracle a property of the
+code structure rather than a test that merely passed once.
+
+Schedule per fused chunk t (``learner/pipeline.IngestOverlap``):
+
+    ingest.commit()     # block t's ring write+tree insert (async jitted
+                        # dispatch, no transfer)
+    dispatch chunk t    # K scanned grad steps in ONE device dispatch
+    ingest.stage()      # ONE device_put of block t+1, riding under
+                        # chunk t's compute
+    trace mark_grad     # traces committed before this dispatch are now
+                        # consumed (wire-to-grad span terminal)
+
+giving ≤ 1 explicit H2D per chunk in steady state. The jitted chunk
+fns are cached per remainder size k (the final sub-K chunk of an ``n``
+not divisible by K compiles once and is reused).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from d4pg_tpu.learner.pipeline import IngestOverlap
+from d4pg_tpu.learner.state import D4PGConfig, D4PGState
+from d4pg_tpu.obs.trace import RECORDER as _trace_recorder
+
+
+class FusedLoop:
+    """Drives fused replay+learn chunks against a device-resident buffer.
+
+    ``buffer`` is a ``FusedDeviceReplay``/``ShardedFusedReplay`` (needs
+    ``.storage``, ``.size`` and — prioritized — ``.trees``). ``service``
+    is the owning ``ReplayService`` when actor rows stream in between
+    chunks (the loop claims the service's single ingest-dispatch slot
+    via ``IngestOverlap``); ``None`` runs the loop against a statically
+    filled buffer (tests, the N=1 oracle)."""
+
+    def __init__(
+        self,
+        config: D4PGConfig,
+        buffer,
+        *,
+        k: int,
+        batch_size: int,
+        prioritized: bool = True,
+        alpha: float = 0.6,
+        beta0: float = 0.4,
+        beta_steps: int = 100_000,
+        mesh=None,
+        service=None,
+        donate: bool = True,
+    ):
+        self._config = config
+        self._buffer = buffer
+        self.k = max(1, int(k))
+        self._batch_size = int(batch_size)
+        self._prioritized = bool(prioritized)
+        self._alpha = float(alpha)
+        self._beta0 = float(beta0)
+        self._beta_steps = int(beta_steps)
+        self._mesh = mesh
+        self._donate = bool(donate)
+        self._fns: dict[int, object] = {}
+        self.ingest = IngestOverlap(service) if service is not None else None
+        self.steps_done = 0
+        self.chunks = 0
+
+    def fused_for(self, k: int):
+        """The jitted fused-chunk fn for chunk length ``k`` (cached)."""
+        if k not in self._fns:
+            from d4pg_tpu.learner.fused import (
+                make_fused_chunk,
+                make_sharded_fused_chunk,
+            )
+
+            kwargs = dict(
+                k=k, batch_size=self._batch_size,
+                prioritized=self._prioritized, alpha=self._alpha,
+                beta0=self._beta0, beta_steps=self._beta_steps,
+                donate=self._donate)
+            self._fns[k] = (
+                make_sharded_fused_chunk(self._config, self._mesh, **kwargs)
+                if self._mesh is not None
+                else make_fused_chunk(self._config, **kwargs))
+        return self._fns[k]
+
+    def run(
+        self,
+        state: D4PGState,
+        n: int,
+        on_chunk: Optional[Callable[[D4PGState, int], None]] = None,
+    ):
+        """``n`` fused grad steps from ``state``; returns ``(state,
+        metrics)`` with the LAST chunk's metrics stacked [k] (``None``
+        when ``n <= 0``). ``on_chunk(state, k)`` fires after each
+        dispatch — step accounting and weight publishing live with the
+        caller, which is what lets the legacy path and a replica share
+        this loop while publishing through different stores."""
+        buffer = self._buffer
+        metrics = None
+        done = 0
+        if self.ingest is not None:
+            # cycle boundary: every staged row lands before training
+            self.ingest.flush()
+        while done < n:
+            k = min(self.k, n - done)
+            fn = self.fused_for(k)
+            if self.ingest is not None:
+                self.ingest.commit()
+            if self._prioritized:
+                state, buffer.trees, metrics = fn(
+                    state, buffer.trees, buffer.storage, buffer.size)
+            else:
+                state, metrics = fn(state, buffer.storage, buffer.size)
+            if self.ingest is not None:
+                self.ingest.stage()
+            # traces whose rows committed before this dispatch are now
+            # consumed; near-free no-op when nothing is pending
+            _trace_recorder.mark_grad()
+            done += k
+            self.steps_done += k
+            self.chunks += 1
+            if on_chunk is not None:
+                on_chunk(state, k)
+        return state, metrics
+
+    def close(self) -> None:
+        """Release the service's ingest-dispatch slot so a successor
+        consumer (a respawned replica) can claim it."""
+        if self.ingest is not None:
+            self.ingest.release()

@@ -21,11 +21,22 @@ need any of this — its write-back happens inside the dispatch.)
 
 from __future__ import annotations
 
+import threading
+import weakref
+from contextlib import contextmanager
 from typing import Any, Callable, Optional
 
 import numpy as np
 
 from d4pg_tpu.replay.staging import DeviceStager
+
+
+class IngestDispatchError(RuntimeError):
+    """A second consumer raced the service's single ingest-dispatch slot
+    (two live ``IngestOverlap`` owners, or concurrent commit/stage/flush
+    calls on one). The double-buffer schedule is single-consumer by
+    construction — a silent second dispatcher would interleave ring
+    writes and corrupt replay, so this fails loudly instead."""
 
 
 class IngestOverlap:
@@ -50,31 +61,83 @@ class IngestOverlap:
     boundaries (``flush``), and the staging ring drops oldest beyond its
     bound. Works against ``ReplayService`` (whose ``ingest_stage`` falls
     back to a full drain for buffers without the block API).
+
+    **Single-consumer, enforced.** The commit/stage handoff mutates the
+    service's ONE staged-block slot; two dispatchers would interleave
+    ring writes and silently corrupt replay. Construction therefore
+    claims the service's ingest-dispatch slot (a weakly-held owner
+    token — a dropped overlap releases it via GC, an explicit successor
+    calls ``release()``), and every dispatch holds a non-blocking busy
+    token so a concurrent commit/stage/flush — the shape a second
+    learner replica would produce — raises ``IngestDispatchError``
+    instead of corrupting. Multi-replica learners (``--learners N>1``)
+    must use the host-sampled path, which is why ``LearnerReplica``
+    only builds a ``FusedLoop`` when it is the sole consumer.
     """
 
     def __init__(self, service):
+        owner_ref = getattr(service, "_ingest_overlap_owner", None)
+        owner = owner_ref() if owner_ref is not None else None
+        if owner is not None:
+            raise IngestDispatchError(
+                "ReplayService already has a live IngestOverlap consumer "
+                f"({owner!r}); the fused ingest handoff is single-consumer "
+                "— release() the current owner first, or use the "
+                "host-sampled path for concurrent learner replicas")
+        service._ingest_overlap_owner = weakref.ref(self)
         self._service = service
+        # busy token, held across each dispatch into the service: plain
+        # non-blocking Lock — contention IS the defect being detected,
+        # so the loser raises instead of waiting
+        self._busy = threading.Lock()
         self.rows_committed = 0
         self.rows_staged = 0
         self.blocks = 0
 
+    @contextmanager
+    def _dispatch(self, op: str):
+        if not self._busy.acquire(blocking=False):
+            raise IngestDispatchError(
+                f"concurrent IngestOverlap.{op}() while another dispatch "
+                "is in flight — the double-buffer handoff is "
+                "single-consumer")
+        try:
+            owner_ref = getattr(self._service, "_ingest_overlap_owner", None)
+            if owner_ref is None or owner_ref() is not self:
+                raise IngestDispatchError(
+                    f"IngestOverlap.{op}() after ownership moved to another "
+                    "consumer (release()d, or a successor claimed the slot)")
+            yield
+        finally:
+            self._busy.release()
+
     def commit(self) -> int:
-        n = self._service.ingest_commit()
-        self.rows_committed += n
-        self.blocks += 1 if n else 0
-        return n
+        with self._dispatch("commit"):
+            n = self._service.ingest_commit()
+            self.rows_committed += n
+            self.blocks += 1 if n else 0
+            return n
 
     def stage(self) -> int:
-        n = self._service.ingest_stage()
-        self.rows_staged += n
-        return n
+        with self._dispatch("stage"):
+            n = self._service.ingest_stage()
+            self.rows_staged += n
+            return n
 
     def flush(self) -> int:
         """Synchronous full drain (cycle boundary / checkpoint): every
         staged row lands before the next sample."""
-        n = self._service.drain_device()
-        self.rows_committed += n
-        return n
+        with self._dispatch("flush"):
+            n = self._service.drain_device()
+            self.rows_committed += n
+            return n
+
+    def release(self) -> None:
+        """Give up the service's ingest-dispatch slot (idempotent) so a
+        successor consumer — e.g. a respawned replica — can claim it."""
+        owner_ref = getattr(self._service, "_ingest_overlap_owner", None)
+        if owner_ref is not None and owner_ref() is self:
+            self._service._ingest_overlap_owner = None
 
 
 class ChunkPipeline:

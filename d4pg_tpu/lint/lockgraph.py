@@ -89,6 +89,8 @@ _DEFAULT_TIERS = {
     "_lock": "service",
     "_buffer_lock": "buffer",
     "_commit_cond": "commit",
+    "_replica_lock": "replica",
+    "_agg_cond": "agg",
     "_relay_lock": "wrelay",
     "_frame_lock": "wserve",
     "_store_lock": "wstore",
@@ -104,8 +106,9 @@ _DEFAULT_TIERS = {
 # the lint package is stdlib-only by contract (``d4pg_tpu.core``'s
 # package __init__ pulls jax). tests/test_locking.py pins the two
 # tables equal, so they cannot drift.
-_TIER_VALUES = {"service": 50, "buffer": 40, "commit": 30, "wrelay": 28,
-                "wserve": 26, "wstore": 24, "shard": 20, "ring": 10}
+_TIER_VALUES = {"service": 50, "buffer": 40, "replica": 36, "agg": 34,
+                "commit": 30, "wrelay": 28, "wserve": 26, "wstore": 24,
+                "shard": 20, "ring": 10}
 
 
 def _tier_values() -> dict[str, int]:

@@ -46,7 +46,8 @@ from d4pg_tpu.envs import (
 from d4pg_tpu.io import CheckpointManager, CsvLogger, MetricsBus, TensorBoardSink
 from d4pg_tpu.io.profiling import StepTimer, xla_trace
 from d4pg_tpu.learner import init_state, make_multi_update, make_update
-from d4pg_tpu.learner.pipeline import ChunkPipeline, IngestOverlap
+from d4pg_tpu.learner.loop import FusedLoop
+from d4pg_tpu.learner.pipeline import ChunkPipeline
 from d4pg_tpu.parallel import (
     MeshSpec,
     make_mesh,
@@ -732,7 +733,13 @@ def train(cfg: ExperimentConfig) -> dict:
     # a device sync mid-pipeline.
     lstep = int(jax.device_get(state.step))
 
+    # filled by the multi-learner block below (--learners N > 1); empty
+    # means the legacy single-learner paths own the weight stream
+    replicas: list = []
+
     def publish():
+        if replicas:
+            return  # the aggregator owns the version stream (one writer)
         p = state.actor_params if mesh is None else jax.device_get(state.actor_params)
         weights.publish(p, step=lstep, norm_stats=_norm_snapshot())
 
@@ -765,87 +772,66 @@ def train(cfg: ExperimentConfig) -> dict:
 
     # Fully-fused chunks (learner/fused.py): sample + gather + update +
     # priority write-back inside ONE scanned dispatch against the
-    # device-resident ring and trees. Cached per remainder size k.
-    fused_fns: dict[int, object] = {}
-
-    def fused_for(k: int):
-        if k not in fused_fns:
-            from d4pg_tpu.learner.fused import (
-                make_fused_chunk,
-                make_sharded_fused_chunk,
-            )
-
-            kwargs = dict(
-                k=k, batch_size=cfg.batch_size,
-                prioritized=cfg.prioritized_replay, alpha=cfg.per_alpha,
-                beta0=cfg.per_beta0, beta_steps=cfg.per_beta_steps,
-                donate=True)
-            fused_fns[k] = (
-                make_sharded_fused_chunk(config, mesh, **kwargs)
-                if mesh is not None else make_fused_chunk(config, **kwargs))
-        return fused_fns[k]
+    # device-resident ring and trees. The commit -> dispatch -> stage
+    # schedule lives in learner/loop.FusedLoop — the SAME class a
+    # LearnerReplica drives, so N=1-through-the-aggregator being bitwise
+    # the legacy loop is a property of the code structure, not a test
+    # that happened to pass once.
+    fused_loop = (
+        FusedLoop(
+            config, buffer, k=K, batch_size=cfg.batch_size,
+            prioritized=cfg.prioritized_replay, alpha=cfg.per_alpha,
+            beta0=cfg.per_beta0, beta_steps=cfg.per_beta_steps,
+            mesh=mesh, service=service, donate=True)
+        if fused else None)
 
     # whole-tree on-device param copy in ONE dispatch (async publish below)
     copy_params = jax.jit(
         lambda p: jax.tree_util.tree_map(jnp.copy, p))
 
-    ingest = IngestOverlap(service)
-
     # Wire-to-grad tracing (docs/architecture.md "Observability plane"):
     # arm the receiver-side span recorder; frames sampled by raw-codec
     # remote actors get their grad-consumption span stamped right after
-    # each fused dispatch below (the host-side proxy for "a grad step
-    # consumed these rows" — the device runs async and observing the
-    # kernel would cost the sync the plane exists to avoid).
+    # each fused dispatch (FusedLoop.run calls mark_grad — the host-side
+    # proxy for "a grad step consumed these rows"; the device runs async
+    # and observing the kernel would cost the sync the plane exists to
+    # avoid).
     from d4pg_tpu.obs.trace import RECORDER as trace_recorder
 
     if cfg.trace_sample > 0:
         trace_recorder.enable(cfg.trace_sample)
 
+    def _publish_async(chunk_state, step):
+        """Bounded staleness <= K without stalling the dispatch
+        pipeline: an on-device param copy (async dispatch; the next
+        chunk's donation would otherwise invalidate the buffers readers
+        hold) instead of a blocking D2H pull. Multi-host actors act on
+        host arrays (a replicated global array would pin the actor's
+        jit to the global mesh), so there the pull is D2H."""
+        if multi_host:
+            weights.publish(jax.device_get(chunk_state.actor_params),
+                            step=step, norm_stats=_norm_snapshot())
+        else:
+            weights.publish(copy_params(chunk_state.actor_params),
+                            step=step, to_host=False,
+                            norm_stats=_norm_snapshot())
+
     def train_steps_fused(n: int):
-        """n fused updates. The only host work per chunk is moving staged
-        actor rows onto the device, and that is overlapped: block t's
-        ring-write commits just before chunk t dispatches (async, no
-        transfer) and block t+1's single device_put rides under chunk t's
-        compute (learner/pipeline.IngestOverlap — ≤ 1 explicit H2D per
-        chunk), so the learner never stalls on the tunnel. The cycle
-        boundary still flushes everything: training each cycle sees all
-        rows the collect phase produced."""
+        """n fused updates through the extracted loop. The only host
+        work per chunk is moving staged actor rows onto the device,
+        overlapped by FusedLoop's commit/dispatch/stage schedule (≤ 1
+        explicit H2D per chunk), so the learner never stalls on the
+        tunnel. The cycle boundary still flushes everything: training
+        each cycle sees all rows the collect phase produced."""
         nonlocal state, lstep
-        metrics = None
-        done = 0
-        ingest.flush()
-        while done < n:
-            k = min(K, n - done)
-            fn = fused_for(k)
-            ingest.commit()
-            if cfg.prioritized_replay:
-                state, buffer.trees, metrics = fn(
-                    state, buffer.trees, buffer.storage, buffer.size)
-            else:
-                state, metrics = fn(state, buffer.storage, buffer.size)
-            ingest.stage()
-            # traces whose rows committed before this dispatch are now
-            # consumed; near-free no-op when nothing is pending
-            trace_recorder.mark_grad()
-            done += k
+
+        def on_chunk(chunk_state, k):
+            nonlocal lstep
             lstep += k
             if cfg.async_actors:
-                # bounded staleness <= K without stalling the dispatch
-                # pipeline: an on-device param copy (async dispatch; the
-                # next chunk's donation would otherwise invalidate the
-                # buffers readers hold) instead of a blocking D2H pull.
-                # Multi-host actors act on host arrays (a replicated
-                # global array would pin the actor's jit to the global
-                # mesh), so there the pull is D2H.
-                if multi_host:
-                    weights.publish(jax.device_get(state.actor_params),
-                                    step=lstep,
-                                    norm_stats=_norm_snapshot())
-                else:
-                    weights.publish(copy_params(state.actor_params),
-                                    step=lstep, to_host=False,
-                                    norm_stats=_norm_snapshot())
+                _publish_async(chunk_state, lstep)
+
+        state, metrics = fused_loop.run(state, n, on_chunk=on_chunk)
         if metrics is None:
             return None
         return {name: metrics[name][-1]
@@ -949,6 +935,8 @@ def train(cfg: ExperimentConfig) -> dict:
     def train_steps(n: int):
         """n updates: pipelined K-chunks, then single-dispatch remainder."""
         nonlocal state
+        if replicas:
+            return train_steps_multi(n)
         if fused:
             return train_steps_fused(n)
         _refresh_weight_base()
@@ -974,6 +962,101 @@ def train(cfg: ExperimentConfig) -> dict:
             for name, v in metrics.items()
             if name in ("critic_loss", "actor_loss", "q_mean")
         }
+
+    # --- multi-learner plane (--learners N > 1) ----------------------------
+    # N LearnerReplica threads, each owning a full D4PGState (its own
+    # optimizer state + PRNG key), sample the shared ReplayService
+    # concurrently; the Aggregator merges their version-stamped updates
+    # into the ONE WeightStore stream with IMPACT-style staleness
+    # weighting, so actors/relays keep seeing a single monotone
+    # (generation, version) sequence (learner/aggregator.py).
+    aggregator = None
+    replica_failures: dict[int, int] = {}
+    if cfg.learners > 1:
+        if fused:
+            raise ValueError(
+                "--learners > 1 needs the host-sampled replay path "
+                "(fused device replay is single-consumer by construction "
+                "— pass --fused_replay off)")
+        if multi_host or mesh is not None:
+            raise ValueError(
+                "--learners > 1 composes with single-host unmeshed "
+                "learners only (scale within a host first)")
+        from d4pg_tpu.learner.aggregator import Aggregator
+        from d4pg_tpu.learner.replica import LearnerReplica
+
+        aggregator = Aggregator(
+            weights, mode=cfg.agg_mode, clip=cfg.agg_clip,
+            # actors pull acting params only; the full 4-subtree merge
+            # tree stays between replicas and aggregator
+            extract=lambda tree: tree["actor_params"],
+            norm_stats=_norm_snapshot)
+        for i in range(cfg.learners):
+            # identical network init across replicas, decorrelated
+            # sampling/noise keys (replica 0 keeps the original chain).
+            # Every replica gets its OWN buffer copy: updates donate
+            # their input state, and donated leaves shared between
+            # replicas would be deleted under each other
+            rstate = jax.tree_util.tree_map(jnp.copy, state)
+            if i:
+                rstate = rstate._replace(
+                    key=jax.random.fold_in(rstate.key, i))
+            replicas.append(LearnerReplica(
+                i, config, aggregator, rstate, k=K,
+                batch_size=cfg.batch_size,
+                prioritized=cfg.prioritized_replay, alpha=cfg.per_alpha,
+                beta0=cfg.per_beta0, beta_steps=cfg.per_beta_steps,
+                service=service))
+        print(f"multi-learner plane: {cfg.learners} replicas, "
+              f"mode={cfg.agg_mode} clip={cfg.agg_clip}", flush=True)
+
+    def train_steps_multi(n: int):
+        """Fan the cycle's n grad steps across the replicas: each runs
+        ONE basis-adopt -> ceil(n/N) steps -> version-stamped submit
+        round on its own thread. Supervision mirrors the actor story: a
+        crashed replica is fenced (so its in-flight update bounces at
+        the aggregator) and respawned at the next epoch, with the same
+        consecutive-failure cap."""
+        nonlocal state, lstep
+        per = -(-n // len(replicas))
+        failed: dict[int, str] = {}
+
+        def run_replica(r):
+            try:
+                r.run_round(per)
+            except Exception:  # noqa: BLE001 — supervisor owns the verdict
+                failed[r.replica_id] = traceback.format_exc()
+
+        threads = [
+            threading.Thread(target=run_replica, args=(r,), daemon=True)
+            for r in replicas]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for r in replicas:
+            if r.replica_id in failed:
+                fails = replica_failures.get(r.replica_id, 0) + 1
+                replica_failures[r.replica_id] = fails
+                print(f"learner replica {r.replica_id} crashed "
+                      f"({fails} consecutive):\n{failed[r.replica_id]}",
+                      flush=True)
+                if fails >= 5:
+                    raise RuntimeError(
+                        f"learner replica {r.replica_id} failed {fails} "
+                        "cycles in a row; giving up")
+                r.respawn()
+            else:
+                replica_failures[r.replica_id] = 0
+        # replica 0's state stands in for `state` downstream (checkpoint,
+        # eval lag accounting); the PUBLISHED params are the aggregate
+        state = replicas[0].state
+        lstep = max([lstep] + [r.steps_done for r in replicas])
+        metrics = replicas[0].last_metrics
+        if metrics is None:
+            return None
+        return {name: metrics[name][-1]
+                for name in ("critic_loss", "actor_loss", "q_mean")}
 
     stop_actors = threading.Event()
     actor_threads: dict[int, threading.Thread] = {}
@@ -1182,6 +1265,12 @@ def train(cfg: ExperimentConfig) -> dict:
     for p in actor_processes:
         if p is not None:
             p.join(timeout=5.0)
+    for r in replicas:
+        r.close()
+    if aggregator is not None:
+        aggregator.close()
+    if fused_loop is not None:
+        fused_loop.close()
     if receiver is not None:
         receiver.close()
     if weight_server is not None:

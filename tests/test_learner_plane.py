@@ -1,0 +1,477 @@
+"""Multi-learner-plane tests (marker ``learner``): the aggregator's
+staleness-corrected merge (learner/aggregator.py), the replica→aggregator
+wire protocol (distributed/update_plane.py), the IngestOverlap
+single-consumer contract, the N=1-through-aggregator ⇔ legacy-fused-loop
+bitwise oracle, the replica-kill chaos smoke, and the bench-artifact
+``learners`` schema gate."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.distributed.transport import ProtocolError
+from d4pg_tpu.distributed.update_plane import (
+    AggregatorServer,
+    UpdateClient,
+    decode_update,
+    encode_update,
+    update_frame_meta,
+)
+from d4pg_tpu.distributed.weights import WeightStore
+from d4pg_tpu.learner.aggregator import Aggregator
+from d4pg_tpu.obs.registry import REGISTRY
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.learner
+
+
+def _params(rng, scale=1.0):
+    return {"w": (scale * rng.standard_normal((4, 3))).astype(np.float32),
+            "b": (scale * rng.standard_normal(3)).astype(np.float32)}
+
+
+def _agg(mode="async", clip=8.0, **kw):
+    return Aggregator(WeightStore(), mode=mode, clip=clip, **kw)
+
+
+# ------------------------------------------------- aggregator: modes ----
+
+def test_bad_mode_and_clip_rejected():
+    with pytest.raises(ValueError):
+        _agg(mode="hogwild")
+    with pytest.raises(ValueError):
+        # clip < 1 would weight stale updates ABOVE fresh ones
+        _agg(clip=0.5)
+
+
+def test_lag0_adopted_wholesale_bitwise(rng):
+    """A fresh submission (lag 0) IS the next aggregate — the exact
+    identity fast-path, not a float blend that happens to be close."""
+    agg = _agg()
+    epoch = agg.register(0, params=_params(rng))
+    sub = _params(rng)
+    basis_version, _ = agg.basis(0)
+    res = agg.submit(0, epoch, sub, basis_version)
+    assert res == {"status": "applied", "version": 1, "lag": 0,
+                   "weight": 1.0, "clipped": False}
+    _v, cur = agg.current()
+    for k in sub:
+        np.testing.assert_array_equal(cur[k], sub[k])
+    agg.close()
+
+
+def test_stale_correction_arithmetic(rng):
+    """lag=1 applies params + 0.5*(new - params) leaf-wise,
+    dtype-preserving."""
+    agg = _agg()
+    e0 = agg.register(0, params=_params(rng))
+    e1 = agg.register(1)
+    b1, _ = agg.basis(1)                      # replica 1 pulls at v0
+    agg.submit(0, e0, _params(rng), agg.basis(0)[0])  # v1: r1 now stale
+    _v, before = agg.current()
+    before = {k: v.copy() for k, v in before.items()}
+    sub = _params(rng)
+    res = agg.submit(1, e1, sub, b1)
+    assert res["status"] == "applied" and res["lag"] == 1
+    assert res["weight"] == pytest.approx(0.5) and not res["clipped"]
+    _v, cur = agg.current()
+    for k in sub:
+        expect = (before[k]
+                  + np.float32(0.5) * (sub[k] - before[k])).astype(np.float32)
+        np.testing.assert_array_equal(cur[k], expect)
+        assert cur[k].dtype == np.float32
+    agg.close()
+
+
+def test_clip_floor_bounds_very_stale_updates(rng):
+    """raw 1/(1+lag) below 1/clip engages the floor: a very stale but
+    live replica keeps a bounded vote, and the engagement is counted."""
+    agg = _agg(clip=2.0)
+    e0 = agg.register(0, params=_params(rng))
+    e1 = agg.register(1)
+    b1, _ = agg.basis(1)
+    for _ in range(5):                        # drive replica 1's lag to 5
+        agg.submit(0, e0, _params(rng), agg.basis(0)[0])
+    res = agg.submit(1, e1, _params(rng), b1)
+    assert res["status"] == "applied" and res["lag"] == 5
+    assert res["weight"] == pytest.approx(0.5)   # floored at 1/clip
+    assert res["clipped"] is True
+    snap = agg._snapshot()
+    assert snap["clip_rate"] == pytest.approx(1 / 6, abs=1e-4)
+    assert snap["replicas"]["1"]["lag"] == 5
+    agg.close()
+
+
+def test_basis_never_reserves_own_submission(rng):
+    """The sole replica must never re-adopt its own round-tripped params
+    — the precondition of the N=1 bitwise oracle."""
+    agg = _agg()
+    epoch = agg.register(0, params=_params(rng))
+    v, basis = agg.basis(0)
+    assert v == 0 and basis is None           # nothing newer than its own
+    agg.submit(0, epoch, _params(rng), v)
+    v, basis = agg.basis(0)
+    assert v == 1 and basis is None           # its OWN submit: still None
+    e1 = agg.register(1)
+    agg.submit(1, e1, _params(rng), agg.basis(1)[0])
+    v, basis = agg.basis(0)
+    assert v == 2 and basis is not None       # someone else advanced it
+    agg.close()
+
+
+def test_future_basis_is_a_protocol_breach(rng):
+    agg = _agg()
+    epoch = agg.register(0, params=_params(rng))
+    res = agg.submit(0, epoch, _params(rng), basis_version=7)
+    assert res["status"] == "fenced" and res["lag"] == -7
+    agg.close()
+
+
+# ------------------------------------------------- aggregator: sync ----
+
+def test_sync_barrier_averages_in_float64(rng):
+    agg = _agg(mode="sync")
+    e0 = agg.register(0, params=_params(rng))
+    e1 = agg.register(1)
+    a, b = _params(rng), _params(rng)
+    results = {}
+
+    def worker(rid, epoch, sub):
+        results[rid] = agg.submit(rid, epoch, sub, agg.basis(rid)[0])
+
+    t = threading.Thread(target=worker, args=(0, e0, a), daemon=True)
+    t.start()
+    time.sleep(0.1)                           # r0 parked on the barrier
+    worker(1, e1, b)
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    for rid in (0, 1):
+        assert results[rid]["status"] == "applied"
+        assert results[rid]["weight"] == pytest.approx(0.5)
+        assert results[rid]["version"] == 1   # ONE publish for the round
+    _v, cur = agg.current()
+    for k in a:
+        expect = ((a[k].astype(np.float64) + b[k].astype(np.float64))
+                  / 2).astype(np.float32)
+        np.testing.assert_array_equal(cur[k], expect)
+    agg.close()
+
+
+def test_sync_fence_releases_survivors_sole_contributor_exact(rng):
+    """A replica killed mid-round is dropped from the barrier; the
+    survivor completes as sole contributor and is adopted EXACTLY."""
+    agg = _agg(mode="sync")
+    e0 = agg.register(0, params=_params(rng))
+    agg.register(1)
+    sub = _params(rng)
+    results = {}
+
+    def worker():
+        results[0] = agg.submit(0, e0, sub, agg.basis(0)[0])
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    agg.fence_replica(1)                      # the kill unwedges the round
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert results[0]["status"] == "applied"
+    _v, cur = agg.current()
+    for k in sub:
+        np.testing.assert_array_equal(cur[k], sub[k])
+    agg.close()
+
+
+# ------------------------------------------- aggregator: fencing -------
+
+def test_epoch_and_generation_fencing(rng):
+    agg = _agg()
+    epoch = agg.register(0, params=_params(rng))
+    assert agg.live_epoch(0) == epoch
+    agg.fence_replica(0)
+    assert agg.live_epoch(0) is None
+    res = agg.submit(0, epoch, _params(rng), 0)   # dead-epoch arrival
+    assert res["status"] == "fenced"
+    epoch2 = agg.register(0)                      # respawn: next epoch
+    assert epoch2 == epoch + 1
+    res = agg.submit(0, epoch2, _params(rng), agg.basis(0)[0],
+                     generation=99)               # wrong store generation
+    assert res["status"] == "fenced"
+    assert agg.counters()["fenced"] == 2
+    assert agg.counters()["applied"] == 0
+    agg.close()
+
+
+def test_ledger_monotone_across_fences(rng):
+    agg = _agg()
+    epoch = agg.register(0, params=_params(rng))
+    for _ in range(3):
+        agg.submit(0, epoch, _params(rng), agg.basis(0)[0])
+        agg.fence_replica(0)
+        epoch = agg.register(0)
+    ledger = agg.ledger()
+    assert [v for _g, v in ledger] == [1, 2, 3]
+    assert agg.ledger_monotone() is True
+    agg.close()
+
+
+@pytest.mark.obs
+def test_obs_learner_provider_exported(rng):
+    """The aggregator's ``learner`` provider rides the registry export —
+    the per-replica lag / clip-rate surface the chaos report reads."""
+    agg = _agg()
+    epoch = agg.register(0, params=_params(rng))
+    agg.submit(0, epoch, _params(rng), agg.basis(0)[0])
+    snap = REGISTRY.export().get("learner")
+    assert snap is not None
+    assert snap["mode"] == "async" and snap["version"] == 1
+    assert snap["live_replicas"] == 1 and snap["applied"] == 1
+    assert snap["replicas"]["0"]["submits"] == 1
+    assert snap["staleness"]["count"] == 1
+    agg.close()
+    assert "learner" not in REGISTRY.export()
+
+
+# ------------------------------------------------- wire protocol -------
+
+def test_update_frame_roundtrip_and_header_only_meta(rng):
+    params = _params(rng)
+    frame = encode_update(params, replica_id=3, epoch=2, generation=1,
+                          basis_version=17, step=40, trace_id=99)
+    meta = update_frame_meta(frame)           # header-only: no payload read
+    assert (meta["replica_id"], meta["epoch"], meta["generation"]) == (3, 2, 1)
+    assert (meta["basis_version"], meta["step"]) == (17, 40)
+    assert meta["trace_id"] == 99 and meta["codec"] == "f32"
+    meta2, decoded = decode_update(frame)
+    assert meta2["crc"] == meta["crc"]
+    for k in params:
+        np.testing.assert_array_equal(decoded[k], params[k])  # f32: bitwise
+
+
+def test_update_frame_quantized_codecs(rng):
+    params = _params(rng)
+    for codec, atol in (("bf16", 0.05), ("int8", 0.05)):
+        frame = encode_update(params, replica_id=0, epoch=1, generation=0,
+                              basis_version=0, codec=codec)
+        _meta, decoded = decode_update(frame)
+        for k in params:
+            assert decoded[k].dtype == np.float32
+            np.testing.assert_allclose(decoded[k], params[k], atol=atol)
+
+
+def test_torn_payload_detected_never_merged(rng):
+    frame = bytearray(encode_update(_params(rng), replica_id=0, epoch=1,
+                                    generation=0, basis_version=0))
+    frame[-1] ^= 0xFF
+    with pytest.raises(ProtocolError):
+        decode_update(bytes(frame))
+    update_frame_meta(bytes(frame))           # header path stays oblivious
+
+
+def test_update_plane_tcp_e2e_and_zero_decode_fence(rng):
+    """Submit over a real socket, then fence the replica and replay its
+    genuinely in-flight frame: it must bounce off the HEADER check
+    (fenced_header, payload never decoded) and the version not move."""
+    agg = _agg()
+    server = AggregatorServer(agg)
+    client = UpdateClient("127.0.0.1", server.port)
+    try:
+        epoch = agg.register(0, params=_params(rng))
+        res = client.submit(0, epoch, _params(rng), agg.basis(0)[0],
+                            generation=agg._store.generation)
+        assert res["status"] == "applied" and res["version"] == 1
+        assert res["lag"] == 0 and res["weight"] == pytest.approx(1.0)
+        torn = bytearray(client.last_frame)
+        torn[-1] ^= 0xFF
+        assert client.submit_frame(bytes(torn))["status"] == "torn"
+        agg.fence_replica(0)
+        version_before = agg.version
+        replay = client.submit_frame(client.last_frame)
+        assert replay["status"] == "fenced"
+        assert agg.version == version_before
+        stats = server.stats()
+        assert stats["fenced_header"] == 1 and stats["torn"] == 1
+        assert stats["applied"] == 1
+    finally:
+        client.close()
+        server.close()
+        agg.close()
+
+
+# ------------------------------------- IngestOverlap single consumer ---
+
+class _FakeService:
+    """Just the surface IngestOverlap dispatches into."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.gate.set()
+        self.commits = 0
+
+    def ingest_commit(self):
+        self.gate.wait()
+        self.commits += 1
+        return 1
+
+    def ingest_stage(self):
+        return 0
+
+    def drain_device(self):
+        return 0
+
+
+def test_ingest_overlap_second_consumer_raises():
+    from d4pg_tpu.learner.pipeline import IngestDispatchError, IngestOverlap
+
+    svc = _FakeService()
+    first = IngestOverlap(svc)
+    with pytest.raises(IngestDispatchError):
+        IngestOverlap(svc)                    # live second owner: loud
+    first.release()
+    second = IngestOverlap(svc)               # explicit handoff: fine
+    assert second.commit() == 1
+    with pytest.raises(IngestDispatchError):
+        first.commit()                        # ownership moved away
+    second.release()
+    second.release()                          # idempotent
+
+
+def test_ingest_overlap_concurrent_dispatch_raises():
+    from d4pg_tpu.learner.pipeline import IngestDispatchError, IngestOverlap
+
+    svc = _FakeService()
+    overlap = IngestOverlap(svc)
+    svc.gate.clear()                          # park the first dispatch
+    t = threading.Thread(target=overlap.commit, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    with pytest.raises(IngestDispatchError):
+        overlap.stage()                       # the second-replica shape
+    svc.gate.set()
+    t.join(timeout=5.0)
+    assert svc.commits == 1
+    overlap.release()
+
+
+# ------------------------------------------------- N=1 bitwise oracle --
+
+def test_n1_through_aggregator_bitwise_equals_legacy_loop(rng):
+    """ONE replica driving the extracted FusedLoop through the
+    aggregator must land bit-for-bit the state the legacy fused loop
+    produces — the merge plane at N=1 is the identity, exactly."""
+    import jax
+
+    from d4pg_tpu.learner import D4PGConfig, init_state
+    from d4pg_tpu.learner.loop import FusedLoop
+    from d4pg_tpu.learner.replica import PARAM_FIELDS, LearnerReplica
+    from d4pg_tpu.replay.fused_buffer import FusedDeviceReplay
+    from d4pg_tpu.replay.uniform import TransitionBatch
+
+    OBS, ACT, N, STEPS = 5, 2, 96, 4
+    config = D4PGConfig(obs_dim=OBS, act_dim=ACT, v_min=-10, v_max=10,
+                        n_atoms=11, hidden=(16, 16))
+    batch = TransitionBatch(
+        obs=rng.standard_normal((N, OBS)).astype(np.float32),
+        action=rng.uniform(-1, 1, (N, ACT)).astype(np.float32),
+        reward=rng.standard_normal(N).astype(np.float32),
+        next_obs=rng.standard_normal((N, OBS)).astype(np.float32),
+        done=np.zeros(N, np.float32),
+        discount=np.full(N, 0.99, np.float32))
+
+    def fill():
+        buf = FusedDeviceReplay(N, OBS, ACT, alpha=0.6)
+        buf.add(batch)
+        buf.drain()
+        return buf
+
+    # legacy: the extracted loop driven directly
+    legacy = FusedLoop(config, fill(), k=2, batch_size=8)
+    legacy_state, _ = legacy.run(init_state(config, jax.random.key(0)), STEPS)
+
+    # replica: SAME loop, but basis/submit through a real aggregator
+    agg = _agg()
+    rep = LearnerReplica(0, config, agg, init_state(config, jax.random.key(0)),
+                         k=2, batch_size=8, buffer=fill())
+    res = rep.run_round(STEPS)
+    assert res["status"] == "applied" and res["lag"] == 0
+
+    for f in PARAM_FIELDS:
+        a = jax.device_get(getattr(legacy_state, f))
+        b = jax.device_get(getattr(rep.state, f))
+        jax.tree_util.tree_map(np.testing.assert_array_equal, a, b)
+    np.testing.assert_array_equal(jax.device_get(legacy_state.step),
+                                  jax.device_get(rep.state.step))
+    # and the aggregate IS the submitted tree (lag-0 wholesale adopt)
+    _v, cur = agg.current()
+    jax.tree_util.tree_map(
+        np.testing.assert_array_equal,
+        {f: jax.device_get(cur[f]) for f in PARAM_FIELDS},
+        {f: jax.device_get(getattr(legacy_state, f)) for f in PARAM_FIELDS})
+    rep.close()
+    agg.close()
+
+
+# ------------------------------------------------- chaos smoke ---------
+
+@pytest.mark.fleet
+def test_learner_chaos_smoke():
+    """A small replica-kill run must pass all four gating oracles — the
+    full-size version is the bench artifact's ``learners`` chaos row."""
+    from d4pg_tpu.fleet.learner_chaos import (
+        LearnerChaosConfig,
+        run_learner_chaos,
+    )
+
+    rep = run_learner_chaos(LearnerChaosConfig(
+        n_replicas=2, duration_s=1.5, replica_kills=1, seed=3))
+    assert rep["replica_kills"] == 1
+    assert rep["replayed_fenced"] == rep["replayed_inflight"]
+    assert rep["updates_applied"] > 0 and rep["updates_per_sec"] > 0
+    assert rep["torn"]["detected"] == rep["torn"]["injected"]
+    assert rep["ledger"]["monotone"] is True
+    assert rep["hierarchy_violations"] == 0
+    assert rep["trace"]["orphans"] == 0
+    assert rep["lane_errors"] == 0
+
+
+# ------------------------------------------------- artifact gate -------
+
+@pytest.mark.obs
+def test_fleet_artifact_learners_schema():
+    """The newest committed fleet artifact must carry the learners
+    block: updates/s vs replica count (kill-free rows) plus one chaos
+    run with >=1 replica kill, every replayed in-flight frame fenced,
+    a never-rewinding ledger, 0 hierarchy violations, 0 trace orphans —
+    a later PR that drops any of it fails tier-1 here."""
+    arts = sorted(glob.glob(os.path.join(
+        REPO_ROOT, "docs", "evidence", "fleet", "fleet_*.json")))
+    assert arts, "no committed fleet artifact"
+    with open(arts[-1]) as f:
+        artifact = json.load(f)
+    blk = artifact.get("learners")
+    assert blk, "newest fleet artifact lost its learners block"
+    assert blk["metric"] == "fleet_learners" and blk["schema"] == 1
+    assert [row["n_replicas"] for row in blk["sweep"]] == [1, 2, 4]
+    for row in blk["sweep"]:
+        assert row["updates_per_sec"] > 0
+        assert row["staleness"]["p95"] is not None
+        assert row["ledger_monotone"] is True
+        assert row["trace_orphans"] == 0
+        assert row["hierarchy_violations"] == 0
+    chaos = blk["chaos"]
+    assert chaos["metric"] == "learner_chaos" and chaos["schema"] == 1
+    assert chaos["replica_kills"] >= 1
+    assert chaos["replayed_fenced"] == chaos["replayed_inflight"]
+    assert chaos["torn"]["detected"] == chaos["torn"]["injected"]
+    assert chaos["updates_per_sec"] > 0
+    assert chaos["ledger"]["monotone"] is True
+    assert chaos["hierarchy_violations"] == 0
+    assert chaos["trace"]["orphans"] == 0

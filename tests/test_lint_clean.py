@@ -124,7 +124,8 @@ def test_lock_graph_clean_over_package():
     for lock, tier in (("_lock", "service"), ("_buffer_lock", "buffer"),
                        ("_commit_cond", "commit"), ("cond", "shard"),
                        ("_ring_locks", "ring"), ("_relay_lock", "wrelay"),
-                       ("_frame_lock", "wserve"), ("_store_lock", "wstore")):
+                       ("_frame_lock", "wserve"), ("_store_lock", "wstore"),
+                       ("_replica_lock", "replica"), ("_agg_cond", "agg")):
         assert lock in graph.nodes, sorted(graph.nodes)
         assert graph.nodes[lock] == tier
     # every edge between tier-labeled locks DESCENDS the hierarchy

@@ -32,9 +32,10 @@ def debug_mode():
 
 def test_hierarchy_table_shape():
     # outermost first, strictly decreasing: the five ingest-plane tiers
-    # plus the weight plane's three (relay > server cache > store)
-    assert list(HIERARCHY) == ["service", "buffer", "commit",
-                               "wrelay", "wserve", "wstore",
+    # plus the multi-learner pair (replica > aggregator) and the weight
+    # plane's three (relay > server cache > store)
+    assert list(HIERARCHY) == ["service", "buffer", "replica", "agg",
+                               "commit", "wrelay", "wserve", "wstore",
                                "shard", "ring"]
     tiers = list(HIERARCHY.values())
     assert tiers == sorted(tiers, reverse=True)
