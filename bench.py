@@ -444,6 +444,7 @@ def bench_fleet(ns=(8, 32, 64, 128, 256), duration_s: float = 10.0,
         default_chaos,
         run_learners,
         run_recovery,
+        run_serving,
         run_sweep,
         run_weights,
         shard_sweep,
@@ -489,6 +490,15 @@ def bench_fleet(ns=(8, 32, 64, 128, 256), duration_s: float = 10.0,
     artifact["learners"] = run_learners(
         ns=(1, 2, 4), duration_s=min(duration_s, 4.0), seed=seed,
         replica_kills=2)
+    # serving block: actions/s vs lane count through the continuous-
+    # batching PolicyInferenceServer, the batched-vs-unbatched pair at
+    # equal lane count (the headline ratio — absolute rates are one-core
+    # conservative), and one server-kill + torn-response chaos row with
+    # MTTR. Schema-checked in tier-1 (tests/test_serving.py) like the
+    # blocks above.
+    artifact["serving"] = run_serving(
+        lane_counts=(1, 2, 4), duration_s=min(duration_s, 4.0),
+        seed=seed, server_kills=1)
     return artifact
 
 

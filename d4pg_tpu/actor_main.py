@@ -59,6 +59,8 @@ def run_actor(
     expect_generation: bool = False,
     weight_codec: str | None = None,
     weight_delta: bool = True,
+    policy_port: int | None = None,
+    policy_timeout: float = 0.5,
 ) -> int:
     cfg = cfg.resolve()
     obs_dim, act_dim, obs_dtype = infer_dims(cfg)
@@ -131,9 +133,25 @@ def run_actor(
             [make_env_fn(cfg, seed=cfg.seed + i) for i in range(cfg.num_envs)],
             seed=cfg.seed,
         )
+        policy = None
+        if policy_port is not None:
+            # --policy_port: SEED-style serving — greedy mu comes from
+            # the learner's continuous-batching PolicyInferenceServer;
+            # exploration noise stays here. The weight puller above
+            # still runs, but only to back the degradation ladder's
+            # cached-params fallback (server down -> local mu, counted).
+            import zlib as _zlib
+
+            from d4pg_tpu.serving.client import RemotePolicyClient
+
+            policy = RemotePolicyClient(
+                config, actor_cfg, learner_host, policy_port,
+                secret=secret,
+                lane_id=_zlib.crc32(actor_id.encode()) & 0xFFF,
+                seed=cfg.seed, timeout=policy_timeout, weights=weights)
         actor = ActorWorker(
             actor_id, config, actor_cfg, pool, RemoteReplayClient(sender),
-            weights, seed=cfg.seed, obs_dtype=obs_dtype,
+            weights, seed=cfg.seed, obs_dtype=obs_dtype, policy=policy,
         )
     try:
         done = 0
@@ -244,6 +262,16 @@ def main(argv=None):
                         "codec: f32 (full precision), bf16 (2x smaller, "
                         "rel err <= 2^-8) or int8 (4x smaller, per-tensor "
                         "scale); default: the v1 full-snapshot puller")
+    p.add_argument("--policy_port", type=int, default=None,
+                   help="query greedy actions from the learner's "
+                        "continuous-batching policy server on this port "
+                        "(train.py --serve_policy) instead of acting "
+                        "locally; on timeout/corruption the actor degrades "
+                        "to its cached weights — counted, never a stall "
+                        "(gaussian noise only)")
+    p.add_argument("--policy_timeout", type=float, default=0.5,
+                   help="per-request serving timeout (s) before the "
+                        "cached-params fallback")
     p.add_argument("--weight_delta", type=int, choices=(0, 1), default=1,
                    help="with --weight_codec: 1 (default) pulls per-tensor "
                         "deltas against the last accepted version when the "
@@ -271,7 +299,9 @@ def main(argv=None):
                       codec=ns.codec, trace_sample=ns.trace_sample,
                       expect_generation=bool(ns.expect_generation),
                       weight_codec=ns.weight_codec,
-                      weight_delta=bool(ns.weight_delta))
+                      weight_delta=bool(ns.weight_delta),
+                      policy_port=ns.policy_port,
+                      policy_timeout=ns.policy_timeout)
     print(f"collected {steps} env steps")
 
 

@@ -178,6 +178,17 @@ class ExperimentConfig:
     serve_secret: str = ""  # shared secret gating remote peers ('' = open)
     serve_transitions_port: int = 0  # 0 = ephemeral
     serve_weights_port: int = 0
+    # Serving plane (docs/architecture.md "Serving plane"): stand up the
+    # continuous-batching PolicyInferenceServer next to the transition/
+    # weight servers so remote actors launched with ``--policy_port``
+    # query greedy actions instead of acting locally. Window/row-budget
+    # knobs bound the batcher's coalescing; the staleness SLA is the
+    # declared freshness bound (breaches are counted, not fatal).
+    serve_policy: bool = False
+    serve_policy_port: int = 0  # 0 = ephemeral
+    serve_policy_window_s: float = 0.002
+    serve_policy_max_rows: int = 256
+    serve_policy_sla_s: float = 1.0
     # Weight-broadcast version window (docs/architecture.md "Weight
     # plane"): the server keeps this many recent versions so pullers
     # inside the window receive per-tensor deltas instead of full
@@ -416,6 +427,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--serve_transitions_port", type=int,
                    default=d.serve_transitions_port)
     p.add_argument("--serve_weights_port", type=int, default=d.serve_weights_port)
+    _add_bool_flag(p, "serve_policy", d.serve_policy,
+                   "serve greedy actions to remote actors "
+                   "(--policy_port) via the continuous-batching "
+                   "policy server")
+    p.add_argument("--serve_policy_port", type=int,
+                   default=d.serve_policy_port)
+    p.add_argument("--serve_policy_window_s", type=float,
+                   default=d.serve_policy_window_s,
+                   help="continuous-batching window: the first pending "
+                        "request waits at most this long for riders")
+    p.add_argument("--serve_policy_max_rows", type=int,
+                   default=d.serve_policy_max_rows,
+                   help="row budget per fused serving dispatch")
+    p.add_argument("--serve_policy_sla_s", type=float,
+                   default=d.serve_policy_sla_s,
+                   help="declared params-freshness SLA: batches served "
+                        "from an older snapshot count sla_breaches")
     p.add_argument("--weight_window", type=int, default=d.weight_window,
                    help="weight-broadcast delta window: recent versions "
                         "kept server-side so in-window pullers get "
@@ -467,6 +495,7 @@ def parse_args(argv=None) -> ExperimentConfig:
     ns["debug"] = bool(ns["debug"])
     ns["async_actors"] = bool(ns["async_actors"])
     ns["serve"] = bool(ns["serve"])
+    ns["serve_policy"] = bool(ns["serve_policy"])
     ns["concurrent_eval"] = bool(ns["concurrent_eval"])
     ns["strict_reference"] = bool(ns["strict_reference"])
     ns["normalize_obs"] = bool(ns["normalize_obs"])

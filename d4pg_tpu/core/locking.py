@@ -69,6 +69,12 @@ HIERARCHY: dict[str, int] = {
     # store under the cache lock (wserve -> wstore) — both descend.
     "wrelay": 28,   # WeightRelay._relay_lock (generation swap + counters)
     "wserve": 26,   # WeightServer._frame_lock (version window + frame memo)
+    # Serving plane: the inference server's pending queue + adopted
+    # params live under one condition. Between wserve and wstore: a
+    # refresher that ever snapshots the WeightStore while holding it
+    # (pserve -> wstore) descends, and nothing below the weight band
+    # may climb into it.
+    "pserve": 25,   # PolicyInferenceServer._pserve_cond (pending + params)
     "wstore": 24,   # WeightStore._store_lock (published params + version)
     "shard": 20,    # _IngestShard.cond (admission deque + counters)
     "ring": 10,     # MultiRingStaging._ring_locks[i] (staging ring slices)

@@ -4,9 +4,17 @@ Replaces the acting half of the reference's ``Worker``/``addExperienceToBuffer``
 (``main.py:137-185, 188-368``): where the reference steps one env with
 batch-1 inference and writes into a process-private buffer, the actor here
 steps a vectorized pool with one batched jit'd policy call per tick, folds
-n-step transitions, and streams them to the central replay service. Weights
-are pulled from the ``WeightStore`` when a new version appears (the
-reference pulls from shared memory every train call, ``ddpg.py:247``).
+n-step transitions, and streams them to the central replay service.
+
+Since the serving plane landed, this module is the COMPOSITION layer:
+the policy-query half (weight pulls, exploration noise, epsilon decay,
+device pinning) lives in ``serving/client.py`` behind the
+``PolicyClient`` interface, and the env-stepping half lives in
+``serving/lane.py`` (``VectorActorLane``). ``ActorWorker`` wires a
+local client to a lane — bitwise the pre-split behavior, pinned by the
+serving parity oracle — and ``GoalActorWorker`` drives the same client
+through whole-episode HER rollouts. ``ActorConfig`` and the acting
+device helpers are re-exported from their new home for compatibility.
 
 Actors are stateless-restartable: everything an actor owns (envs, noise,
 n-step window) is rebuilt on restart; replay and weights live with the
@@ -15,103 +23,34 @@ learner (SURVEY.md §5 failure-detection note).
 
 from __future__ import annotations
 
-import contextlib
-import dataclasses
 import threading
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from d4pg_tpu.envs.her import her_relabel
-from d4pg_tpu.envs.normalizer import FrozenNormalizer, RunningMeanStd
 from d4pg_tpu.envs.vector import EnvPool
 from d4pg_tpu.envs.wrappers import flatten_goal_obs, rescale_action
-from d4pg_tpu.core.noise import ou
 from d4pg_tpu.learner.state import D4PGConfig
-from d4pg_tpu.learner.update import act, act_ou
 from d4pg_tpu.distributed.replay_service import ReplayService
 from d4pg_tpu.distributed.weights import WeightStore
-from d4pg_tpu.replay.nstep import NStepFolder
 from d4pg_tpu.replay.uniform import TransitionBatch
-
-
-@dataclasses.dataclass
-class ActorConfig:
-    epsilon_0: float = 0.3  # the reference's live, never-decayed eps (C5)
-    min_epsilon: float = 0.01
-    epsilon_horizon: int = 5000  # episodes to decay over (random_process.py:13)
-    n_step: int = 3
-    gamma: float = 0.99
-    reward_scale: float = 1.0
-    weight_poll_every: int = 1  # pool ticks between version checks
-    # Exploration process. The reference exposes --ou_theta/--ou_sigma/--ou_mu
-    # but never wires OU in (SURVEY.md C6 — constructed nowhere live); here
-    # noise='ou' actually runs the temporally-correlated process.
-    noise: str = "gaussian"  # 'gaussian' | 'ou'
-    # Probability of replacing the policy action with a uniform random one,
-    # per env per tick (the HER recipe's epsilon-greedy component — sparse
-    # goal tasks need undirected exploration that additive Gaussian noise
-    # around a confident wrong policy cannot provide). 0 = reference
-    # behavior (additive noise only, random_process.py:16-18).
-    random_eps: float = 0.0
-    ou_theta: float = 0.25
-    ou_sigma: float = 0.05
-    ou_mu: float = 0.0
-    ou_dt: float = 0.01
-    # Where actor inference runs. Acting is latency-bound batch-E inference
-    # dispatched every pool tick; on a TPU host every tick would round-trip
-    # PCIe (or a remote tunnel) for microseconds of MLP compute, serializing
-    # the env loop on transfer latency and contending with the learner's
-    # dispatch queue. 'cpu' (default) pins the policy forward to the host
-    # CPU backend — the D4PG production shape: the accelerator belongs to
-    # the learner, actors run on TPU-VM host cores. 'default' uses the
-    # default backend (worth it only for big conv encoders + wide pools).
-    device: str = "cpu"  # 'cpu' | 'default'
-
-    def __post_init__(self):
-        if self.noise not in ("gaussian", "ou"):
-            raise ValueError(f"unknown noise process {self.noise!r}")
-        if self.device not in ("cpu", "default"):
-            raise ValueError(f"unknown actor device {self.device!r}")
-
-
-def resolve_act_device(kind: str):
-    """Pinned inference device for an acting/eval component: the host CPU
-    backend for ``'cpu'`` (see ``ActorConfig.device``), None (follow the
-    default backend) for ``'default'``. Shared by actors and the Evaluator
-    so the placement policy lives in one place."""
-    if kind not in ("cpu", "default"):
-        raise ValueError(f"unknown actor device {kind!r}")
-    if kind != "cpu":
-        return None
-    # local_devices, not devices: under jax.distributed the global device
-    # list starts with process 0's devices, so devices("cpu")[0] on any
-    # other process is NON-addressable and acting there either errors or
-    # produces arrays this process cannot read.
-    return jax.local_devices(backend="cpu")[0]
-
-
-def act_device_scope(device):
-    """Thread-local default-device scope for a pinned device (no-op scope
-    when following the default backend)."""
-    if device is None:
-        return contextlib.nullcontext()
-    return jax.default_device(device)
-
-
-def put_params_on(device, params):
-    """Move published params onto the pinned device. Publishes may carry
-    accelerator arrays (the fused learner publishes device params);
-    committed arrays would drag the acting computation back onto the
-    learner's chip."""
-    if device is None:
-        return params
-    return jax.device_put(params, device)
+from d4pg_tpu.serving.client import (  # noqa: F401 — compatibility re-exports
+    ActorConfig,
+    LocalPolicyClient,
+    act_device_scope,
+    put_params_on,
+    resolve_act_device,
+)
+from d4pg_tpu.serving.lane import VectorActorLane
 
 
 class _BaseActor:
-    """Weight-pulling + epsilon-decay machinery shared by actor kinds."""
+    """Transition-sink bookkeeping around one ``PolicyClient``.
+
+    The policy machinery (weight pulls, noise, epsilon) lives in the
+    client; the underscored delegate methods and properties below keep
+    the pre-split surface (``_epsilon``, ``_ou``, ``_maybe_pull_weights``,
+    ``_explore_actions``) working for subclasses and tests."""
 
     def __init__(
         self,
@@ -122,28 +61,15 @@ class _BaseActor:
         weights: WeightStore,
         seed: int = 0,
         obs_norm=None,
+        policy=None,
     ):
         self.actor_id = actor_id
         self.config = config
         self.cfg = actor_cfg
         self.service = service
         self.weights = weights
-        # READ-ONLY normalizer view for the policy input (the networks are
-        # trained on standardized rows — the ReplayService's drain thread
-        # owns the statistics and normalizes at insert). In-process actors
-        # share the service's RunningMeanStd; remote/spawned actors receive
-        # a FrozenNormalizer refreshed from the weight channel (below).
-        # Transitions are ALWAYS streamed raw.
-        self.obs_norm = obs_norm
-        self._act_device = resolve_act_device(actor_cfg.device)
-        with self._device_scope():
-            self._key = jax.random.key(seed)
-        self._version = 0
-        self._params = None
-        self._epsilon = actor_cfg.epsilon_0
-        self._explore_rng = np.random.default_rng(seed + 17)
-        self._episodes = 0
-        self._ou = None  # lazily-sized OU state when cfg.noise == 'ou'
+        self.policy = policy if policy is not None else LocalPolicyClient(
+            config, actor_cfg, weights, seed=seed, obs_norm=obs_norm)
         self._stop = threading.Event()
         self.env_steps = 0
         # Degradation accounting: ``service.add`` returning False (ingest
@@ -153,83 +79,44 @@ class _BaseActor:
         # plane's no-silent-loss rule), never a crash or a silent pass.
         self.dropped_batches = 0
 
-    def _device_scope(self):
-        """Context placing this actor's jax dispatches on its pinned device
-        (thread-local, so actor threads don't disturb the learner's default
-        placement). No-op scope when following the default backend."""
-        return act_device_scope(self._act_device)
+    # -- policy delegates (pre-split surface) -------------------------------
+    @property
+    def obs_norm(self):
+        return self.policy.obs_norm
+
+    @obs_norm.setter
+    def obs_norm(self, value) -> None:
+        self.policy.obs_norm = value
+
+    @property
+    def _epsilon(self) -> float:
+        return self.policy.epsilon
+
+    @property
+    def _version(self) -> int:
+        return self.policy.version
+
+    @property
+    def _params(self):
+        return getattr(self.policy, "params", None)
+
+    @property
+    def _ou(self):
+        return getattr(self.policy, "_ou", None)
 
     def _maybe_pull_weights(self) -> bool:
-        got = self.weights.get_if_newer(self._version)
-        if got is not None:
-            self._version, params = got
-            self._params = put_params_on(self._act_device, params)
-            # Remote/spawned actors: the weight payload piggybacks the
-            # learner's normalization statistics (WeightClient.norm_stats).
-            # An in-process RunningMeanStd handle stays authoritative.
-            ns = getattr(self.weights, "norm_stats", None)
-            if ns is not None and not isinstance(self.obs_norm, RunningMeanStd):
-                if self.obs_norm is None:
-                    self.obs_norm = FrozenNormalizer(*ns)
-                else:
-                    self.obs_norm.set(*ns)
-            return True
-        return False
+        return self.policy.pull()
 
     def _explore_actions(self, obs: np.ndarray) -> np.ndarray:
         """Noisy policy actions for a [B, obs_dim] batch; uniform random
         before the first weight publish (warmup, ``main.py:200-207``)."""
-        with self._device_scope():
-            return self._explore_actions_inner(obs)
-
-    def _explore_actions_inner(self, obs: np.ndarray) -> np.ndarray:
-        self._key, ka = jax.random.split(self._key)
-        if self._params is None:
-            return np.asarray(
-                jax.random.uniform(ka, (obs.shape[0], self.config.act_dim),
-                                   minval=-1.0, maxval=1.0)
-            )
-        if self.cfg.noise == "ou":
-            if self._ou is None or self._ou.x.shape[0] != obs.shape[0]:
-                self._ou = ou.init(self.config.act_dim, (obs.shape[0],))
-            actions, self._ou = act_ou(
-                self.config, self._params, jnp.asarray(obs), self._ou, ka,
-                epsilon=self._epsilon, theta=self.cfg.ou_theta,
-                mu=self.cfg.ou_mu, sigma=self.cfg.ou_sigma, dt=self.cfg.ou_dt,
-            )
-            actions = np.asarray(actions)
-        else:
-            actions = np.asarray(
-                act(self.config, self._params, jnp.asarray(obs), ka,
-                    self._epsilon)
-            )
-        if self.cfg.random_eps > 0.0:
-            rng = self._explore_rng
-            mask = rng.random(actions.shape[0]) < self.cfg.random_eps
-            if mask.any():
-                actions = np.array(actions)  # jax->np output is read-only
-                actions[mask] = rng.uniform(
-                    -1.0, 1.0, (int(mask.sum()), actions.shape[1])
-                ).astype(actions.dtype)
-        return actions
+        return self.policy.actions(obs)
 
     def _reset_noise(self, done_mask: np.ndarray) -> None:
-        """Zero the OU state of envs whose episode ended
-        (``random_process.py:41-45`` resets x on episode reset)."""
-        if self._ou is not None and done_mask.any():
-            with self._device_scope():  # keep the OU state on the pinned device
-                keep = jnp.asarray(~done_mask, jnp.float32)[:, None]
-                self._ou = self._ou._replace(x=self._ou.x * keep)
+        self.policy.reset_noise(done_mask)
 
     def _decay_epsilon(self) -> None:
-        """eps = min + (eps0-min) * exp(-5k/horizon) on episode end — the
-        decay the reference defines but never runs (``random_process.py:
-        19-21``, call commented at ``main.py:366``)."""
-        self._episodes += 1
-        c = self.cfg
-        self._epsilon = c.min_epsilon + (c.epsilon_0 - c.min_epsilon) * float(
-            np.exp(-5.0 * self._episodes / c.epsilon_horizon)
-        )
+        self.policy.decay_epsilon()
 
     def stop(self) -> None:
         self._stop.set()
@@ -238,10 +125,12 @@ class _BaseActor:
 class ActorWorker(_BaseActor):
     """Acting loop over a vectorized EnvPool with n-step folding.
 
-    ``run`` is resumable: the pool is reset once, and both the episode state
-    and the n-step window persist across calls — a cycle boundary in the
-    training loop must NOT restart episodes or drop pending window entries
-    (stale entries stitched across a reset would corrupt transitions).
+    A thin composition since the serving split: the loop itself is
+    ``serving.lane.VectorActorLane`` (shared stop event, shared policy
+    client), so the legacy per-process actor and the serving plane's
+    lanes run LITERALLY the same code. ``run`` stays resumable: the pool
+    is reset once, and both the episode state and the n-step window
+    persist across calls.
     """
 
     def __init__(
@@ -255,47 +144,46 @@ class ActorWorker(_BaseActor):
         seed: int = 0,
         obs_dtype=None,
         obs_norm=None,
+        policy=None,
     ):
+        self._lane = None
         super().__init__(actor_id, config, actor_cfg, service, weights, seed,
-                         obs_norm=obs_norm)
+                         obs_norm=obs_norm, policy=policy)
         self.pool = pool
-        self._folder = NStepFolder(
-            actor_cfg.n_step, actor_cfg.gamma, pool.num_envs,
-            config.obs_spec, config.act_dim, obs_dtype=obs_dtype,
-        )
-        self._obs: np.ndarray | None = None
+        self._lane = VectorActorLane(
+            actor_id, config, actor_cfg, pool, service,
+            obs_dtype=obs_dtype, policy=self.policy, stop=self._stop)
 
     def run(self, max_steps: int) -> int:
         """Collect ``max_steps`` pool ticks (E transitions per tick)."""
-        if self._obs is None:
-            self._obs = self.pool.reset()
-            self._folder.reset()
-        obs = self._obs
-        self._maybe_pull_weights()
-        for tick in range(max_steps):
-            if self._stop.is_set():
-                break
-            if tick % self.cfg.weight_poll_every == 0:
-                self._maybe_pull_weights()
-            if self.obs_norm is not None:
-                actions = self._explore_actions(self.obs_norm.normalize(obs))
-            else:
-                actions = self._explore_actions(obs)
-            out = self.pool.step(actions)
-            folded = self._folder.step(
-                obs, actions, out.reward * self.cfg.reward_scale,
-                out.final_obs, out.terminated, out.truncated,
-            )
-            if not self.service.add(folded, actor_id=self.actor_id):
-                self.dropped_batches += 1
-            done_any = out.terminated | out.truncated
-            self._reset_noise(done_any)
-            for _ in range(int(done_any.sum())):
-                self._decay_epsilon()
-            obs = out.obs
-            self.env_steps += self.pool.num_envs
-        self._obs = obs
-        return self.env_steps
+        return self._lane.run(max_steps)
+
+    # counters live with the lane; these views keep the legacy surface
+    @property
+    def env_steps(self) -> int:
+        return self._lane.env_steps if self._lane is not None else 0
+
+    @env_steps.setter
+    def env_steps(self, value: int) -> None:
+        if self._lane is not None:
+            self._lane.env_steps = int(value)
+
+    @property
+    def dropped_batches(self) -> int:
+        return self._lane.dropped_batches if self._lane is not None else 0
+
+    @dropped_batches.setter
+    def dropped_batches(self, value: int) -> None:
+        if self._lane is not None:
+            self._lane.dropped_batches = int(value)
+
+    @property
+    def _obs(self):
+        return self._lane._obs
+
+    @property
+    def _folder(self):
+        return self._lane._folder
 
 
 class GoalActorWorker(_BaseActor):

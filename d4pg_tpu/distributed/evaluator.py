@@ -15,18 +15,12 @@ import queue
 import threading
 from typing import Callable, Optional
 
-import jax.numpy as jnp
 import numpy as np
 
 from d4pg_tpu.envs.wrappers import flatten_goal_obs, rescale_action
 from d4pg_tpu.learner.state import D4PGConfig
-from d4pg_tpu.learner.update import act_deterministic
-from d4pg_tpu.distributed.actor import (
-    act_device_scope,
-    put_params_on,
-    resolve_act_device,
-)
 from d4pg_tpu.distributed.weights import WeightStore
+from d4pg_tpu.serving.client import ActorConfig, LocalPolicyClient
 
 EWMA_OLD, EWMA_NEW = 0.95, 0.05  # main.py:131
 
@@ -58,31 +52,31 @@ class Evaluator:
         # Greedy rollouts are batch-1 inference per env step — pinned to the
         # host CPU backend by default for the same reason as ActorConfig
         # .device: a per-step accelerator round trip costs more than the MLP
-        # forward, and eval must not contend with the learner's chip.
-        self._device = resolve_act_device(device)
+        # forward, and eval must not contend with the learner's chip. Since
+        # the serving split, the query path is the same PolicyClient the
+        # actors use (greedy mode) instead of a duplicated inline dispatch.
+        self.policy = LocalPolicyClient(
+            config, ActorConfig(device=device), weights)
 
     def _device_scope(self):
-        return act_device_scope(self._device)
+        return self.policy._device_scope()
 
-    def _greedy_episode(self, params, seed: int | None = None) -> tuple[float, bool]:
+    def _greedy_episode(self, seed: int | None = None) -> tuple[float, bool]:
         reset_kw = {"seed": seed} if seed is not None else {}
         obs, _ = self.env.reset(**reset_kw)
         total, success = 0.0, False
-        with self._device_scope():
-            for _ in range(self.max_steps):
-                flat = flatten_goal_obs(obs)
-                if self.obs_norm is not None:
-                    flat = self.obs_norm.normalize(flat)
-                a = np.asarray(
-                    act_deterministic(self.config, params, jnp.asarray(flat[None]))
-                )[0]
-                obs, r, term, trunc, info = self.env.step(
-                    rescale_action(a, self._low, self._high)
-                )
-                total += float(r)
-                success = success or bool(info.get("is_success", False))
-                if term or trunc:
-                    break
+        for _ in range(self.max_steps):
+            flat = flatten_goal_obs(obs)
+            if self.obs_norm is not None:
+                flat = self.obs_norm.normalize(flat)
+            a = self.policy.greedy_actions(flat[None])[0]
+            obs, r, term, trunc, info = self.env.step(
+                rescale_action(a, self._low, self._high)
+            )
+            total += float(r)
+            success = success or bool(info.get("is_success", False))
+            if term or trunc:
+                break
         return total, success
 
     def evaluate(self, n_trials: int = 10, seed: int | None = None) -> dict:
@@ -91,14 +85,13 @@ class Evaluator:
         # Snapshot step WITH the params: the learner may publish again while
         # the rollouts run, and ``learner_step`` must describe the weights
         # actually evaluated (it feeds the eval_lag_steps metric).
-        _, params, published_step = self.weights.snapshot()
-        if params is None:
-            raise RuntimeError("no weights published yet")
-        params = put_params_on(self._device, params)
+        # snapshot_pull adopts the store's CURRENT params regardless of
+        # version — eval must not skip a re-publish of the same version.
+        _, published_step = self.policy.snapshot_pull()
         returns, successes = [], []
         for i in range(n_trials):
             ep_seed = None if seed is None else seed + i
-            ret, suc = self._greedy_episode(params, ep_seed)
+            ret, suc = self._greedy_episode(ep_seed)
             returns.append(ret)
             successes.append(suc)
         avg = float(np.mean(returns))

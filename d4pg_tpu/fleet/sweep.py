@@ -368,6 +368,89 @@ def run_learners(
             "sweep": sweep, "chaos": chaos_row, "seed": int(seed)}
 
 
+def run_serving(
+    lane_counts=(1, 2, 4),
+    envs_per_lane: int = 4,
+    duration_s: float = 3.0,
+    seed: int = 0,
+    server_kills: int = 1,
+    torn_prob: float = 0.05,
+    pair_lanes: int | None = None,
+    **overrides,
+) -> dict:
+    """The bench_fleet serving block (``fleet/serving_chaos.py``):
+    actions/s vs lane count from fault-free rows (batch occupancy and
+    request latency percentiles per row), ONE batched-vs-unbatched pair
+    at ``pair_lanes`` (default ``max(lane_counts)`` floored at 16 —
+    continuous batching is a concurrency claim, and at a handful of
+    closed-loop single-row lanes the amortization margin sits inside
+    one-core scheduling noise) with single-row requests — the continuous-
+    batching claim measured on the same wire, BOTH arms at zero window
+    so exactly one thing differs: the batched arm coalesces every
+    pending request into one dispatch (``max_batch_rows`` default)
+    while the unbatched arm pops one request per dispatch
+    (``max_batch_rows=1``), i.e. N independent single-row dispatches.
+    Zero window is the greedy continuous-batching configuration —
+    requests that arrive while a dispatch is in flight coalesce into
+    the next one — and is what isolates dispatch amortization from the
+    window's latency tax (the nonzero default window only pays off for
+    multi-row requests; the sweep rows above measure that default).
+    Also one chaos row (seeded server kills + torn responses) with its
+    MTTR and run-gating oracles. One-core caveat: lanes, server and
+    publisher share the host, so absolute actions/s is conservative;
+    the batched/unbatched ratio is the honest headline."""
+    from d4pg_tpu.fleet.serving_chaos import run_serving_chaos
+
+    sweep = []
+    for n in lane_counts:
+        r = run_serving_chaos(
+            n_lanes=int(n), envs_per_lane=int(envs_per_lane),
+            duration_s=float(duration_s), server_kills=0, torn_prob=0.0,
+            seed=int(seed), **overrides)
+        sweep.append({
+            "n_lanes": int(n),
+            "actions_per_sec": r["actions_per_sec"],
+            "requests": r["requests"],
+            "served": r["served"],
+            "fallbacks": r["fallbacks"],
+            "batch_occupancy": r["batch_occupancy"],
+            "latency_ms": r["latency_ms"],
+            "trace_orphans": r["trace"]["orphans"],
+            "hierarchy_violations": r["hierarchy_violations"],
+        })
+
+    # the batching claim: same lanes, same wire, single-row requests,
+    # both arms at zero window; only the coalescing cap differs
+    n_pair = int(pair_lanes if pair_lanes is not None
+                 else max(max(lane_counts), 16))
+    batched = run_serving_chaos(
+        n_lanes=n_pair, envs_per_lane=1, duration_s=float(duration_s),
+        server_kills=0, torn_prob=0.0, seed=int(seed) + 1,
+        batch_window_s=0.0, **overrides)
+    unbatched = run_serving_chaos(
+        n_lanes=n_pair, envs_per_lane=1, duration_s=float(duration_s),
+        server_kills=0, torn_prob=0.0, seed=int(seed) + 1,
+        batch_window_s=0.0, max_batch_rows=1, **overrides)
+    pair = {
+        "n_lanes": n_pair,
+        "batched_actions_per_sec": batched["actions_per_sec"],
+        "unbatched_actions_per_sec": unbatched["actions_per_sec"],
+        "speedup": (round(batched["actions_per_sec"]
+                          / unbatched["actions_per_sec"], 3)
+                    if unbatched["actions_per_sec"] else None),
+        "batched_latency_ms": batched["latency_ms"],
+        "unbatched_latency_ms": unbatched["latency_ms"],
+        "batched_occupancy": batched["batch_occupancy"],
+    }
+
+    chaos_row = run_serving_chaos(
+        n_lanes=int(max(lane_counts)), envs_per_lane=int(envs_per_lane),
+        duration_s=float(duration_s), server_kills=int(server_kills),
+        torn_prob=float(torn_prob), seed=int(seed), **overrides)
+    return {"metric": "fleet_serving", "schema": 1, "sweep": sweep,
+            "batching": pair, "chaos": chaos_row, "seed": int(seed)}
+
+
 def _lock_wait_ms(row: dict) -> float | None:
     """Total contended-acquisition wait across every tiered lock."""
     locks = row.get("locks")
@@ -424,6 +507,12 @@ def main(argv=None):
                     help="run the multi-learner block instead: updates/s "
                          "vs these replica counts + one replica-kill "
                          "chaos row (fleet/learner_chaos.py)")
+    ap.add_argument("--serving", type=int, nargs="+", default=None,
+                    metavar="LANES",
+                    help="run the serving block instead: actions/s vs "
+                         "these lane counts, a batched-vs-unbatched pair "
+                         "and one server-kill chaos row "
+                         "(fleet/serving_chaos.py)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--no_chaos", action="store_true",
                     help="clean-plane control run (all fault probs 0)")
@@ -432,7 +521,13 @@ def main(argv=None):
     ns = ap.parse_args(argv)
     chaos = (ChaosConfig(seed=ns.seed) if ns.no_chaos
              else default_chaos(ns.seed))
-    if ns.learners:
+    if ns.serving:
+        artifact = run_serving(
+            lane_counts=tuple(ns.serving), duration_s=ns.seconds,
+            seed=ns.seed,
+            **({"server_kills": 0, "torn_prob": 0.0}
+               if ns.no_chaos else {}))
+    elif ns.learners:
         artifact = run_learners(
             ns=tuple(ns.learners), duration_s=ns.seconds, seed=ns.seed,
             **({"replica_kills": 0, "torn_prob": 0.0}

@@ -93,6 +93,7 @@ _DEFAULT_TIERS = {
     "_agg_cond": "agg",
     "_relay_lock": "wrelay",
     "_frame_lock": "wserve",
+    "_pserve_cond": "pserve",
     "_store_lock": "wstore",
     "cond": "shard",
     "shard_lock": "shard",
@@ -107,8 +108,8 @@ _DEFAULT_TIERS = {
 # package __init__ pulls jax). tests/test_locking.py pins the two
 # tables equal, so they cannot drift.
 _TIER_VALUES = {"service": 50, "buffer": 40, "replica": 36, "agg": 34,
-                "commit": 30, "wrelay": 28, "wserve": 26, "wstore": 24,
-                "shard": 20, "ring": 10}
+                "commit": 30, "wrelay": 28, "wserve": 26, "pserve": 25,
+                "wstore": 24, "shard": 20, "ring": 10}
 
 
 def _tier_values() -> dict[str, int]:

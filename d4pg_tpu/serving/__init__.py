@@ -1,0 +1,30 @@
+"""Serving plane: vectorized actor lanes + continuous-batching policy
+inference (ROADMAP direction #2; arXiv 1803.02811's batched-inference
+shape, SEED-style split between env stepping and policy queries).
+
+Modules:
+
+- ``protocol`` — the CRC-framed request/response wire format
+  (magics 0xD4E2/0xD4E3, the fifth dual-magic plane).
+- ``client`` — the ``PolicyClient`` interface: ``LocalPolicyClient``
+  (in-process inference, bitwise the legacy actor's policy half) and
+  ``RemotePolicyClient`` (wire round trips with a counted degradation
+  ladder). Also home of ``ActorConfig`` and the acting device helpers.
+- ``server`` — ``PolicyInferenceServer``: bounded-window continuous
+  batching into padded power-of-two buckets, fenced (generation,
+  version) adoption under a declared freshness SLA, the ``serving``
+  obs provider, and ``ServingChaos`` torn-response injection.
+- ``lane`` — ``VectorActorLane``: the env-stepping half (EnvPool +
+  n-step folding + transition sink) against any policy client.
+"""
+
+from d4pg_tpu.serving.client import (  # noqa: F401
+    ActorConfig,
+    LocalPolicyClient,
+    RemotePolicyClient,
+)
+from d4pg_tpu.serving.lane import VectorActorLane  # noqa: F401
+from d4pg_tpu.serving.server import (  # noqa: F401
+    PolicyInferenceServer,
+    ServingChaos,
+)

@@ -1,0 +1,113 @@
+"""VectorActorLane: the env-stepping half of acting.
+
+One lane owns an ``EnvPool`` (E envs stepping in lockstep), an n-step
+folder, and a transition sink (``ReplayService`` or the
+``RemoteReplayClient`` adapter over a ``CoalescingSender``); the policy
+queries go through an injected :class:`PolicyClient` — in-process
+(:class:`~d4pg_tpu.serving.client.LocalPolicyClient`, the legacy shape)
+or the serving wire
+(:class:`~d4pg_tpu.serving.client.RemotePolicyClient`, SEED-style).
+
+This loop IS the pre-serving ``ActorWorker.run``, moved: the tick
+order (poll gate → normalize → act → step → fold → send → noise reset →
+epsilon decay), the reset-once ``_obs`` persistence across ``run``
+calls, and the dropped-batch accounting are unchanged, and
+``distributed.actor.ActorWorker`` now delegates here — so the parity
+oracle (1-env lane + local client ≡ legacy actor, seed for seed) is
+structural, not aspirational.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from d4pg_tpu.learner.state import D4PGConfig
+from d4pg_tpu.envs.vector import EnvPool
+from d4pg_tpu.replay.nstep import NStepFolder
+from d4pg_tpu.serving.client import ActorConfig, LocalPolicyClient
+
+
+class VectorActorLane:
+    """Batched acting loop over a vectorized EnvPool with n-step folding.
+
+    ``run`` is resumable: the pool is reset once, and both the episode
+    state and the n-step window persist across calls — a cycle boundary
+    in the training loop must NOT restart episodes or drop pending
+    window entries (stale entries stitched across a reset would corrupt
+    transitions).
+    """
+
+    def __init__(
+        self,
+        lane_id: str,
+        config: D4PGConfig,
+        actor_cfg: ActorConfig,
+        pool: EnvPool,
+        service,
+        weights=None,
+        seed: int = 0,
+        obs_dtype=None,
+        obs_norm=None,
+        policy=None,
+        stop: threading.Event | None = None,
+    ):
+        self.lane_id = lane_id
+        self.config = config
+        self.cfg = actor_cfg
+        self.pool = pool
+        self.service = service
+        self.policy = policy if policy is not None else LocalPolicyClient(
+            config, actor_cfg, weights, seed=seed, obs_norm=obs_norm)
+        self._folder = NStepFolder(
+            actor_cfg.n_step, actor_cfg.gamma, pool.num_envs,
+            config.obs_spec, config.act_dim, obs_dtype=obs_dtype,
+        )
+        self._obs = None
+        self._stop = stop if stop is not None else threading.Event()
+        self.env_steps = 0
+        # Degradation accounting: ``service.add`` returning False (ingest
+        # backpressure past its timeout) or a drop_on_timeout transport
+        # shedding a frame means replay rows were LOST — benign for
+        # ingest, but it must be a counted, surfaced event (the fleet
+        # plane's no-silent-loss rule), never a crash or a silent pass.
+        self.dropped_batches = 0
+
+    def run(self, max_steps: int) -> int:
+        """Collect ``max_steps`` pool ticks (E transitions per tick)."""
+        if self._obs is None:
+            self._obs = self.pool.reset()
+            self._folder.reset()
+        obs = self._obs
+        policy = self.policy
+        policy.pull()
+        for tick in range(max_steps):
+            if self._stop.is_set():
+                break
+            if tick % self.cfg.weight_poll_every == 0:
+                policy.pull()
+            if policy.obs_norm is not None:
+                actions = policy.actions(policy.obs_norm.normalize(obs))
+            else:
+                actions = policy.actions(obs)
+            out = self.pool.step(actions)
+            folded = self._folder.step(
+                obs, actions, out.reward * self.cfg.reward_scale,
+                out.final_obs, out.terminated, out.truncated,
+            )
+            if not self.service.add(folded, actor_id=self.lane_id):
+                self.dropped_batches += 1
+            done_any = out.terminated | out.truncated
+            policy.reset_noise(done_any)
+            for _ in range(int(done_any.sum())):
+                policy.decay_epsilon()
+            obs = out.obs
+            self.env_steps += self.pool.num_envs
+        self._obs = obs
+        return self.env_steps
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def close(self) -> None:
+        self.policy.close()
+        self.pool.close()

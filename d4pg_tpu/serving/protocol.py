@@ -1,0 +1,169 @@
+"""Serving-plane wire protocol: CRC-framed action request/response.
+
+The fifth wire plane (after ingest 0xD4F6/0xD4F8, weights 0xD4F7/0xD4FC,
+updates 0xD4AB, and the generation greeting 0xD4FA), in the same family:
+a fixed ``!II`` (magic, body_len) outer frame — the transport module's
+framing convention — followed by a fixed inner header and a CRC32 over
+the float payload. The CRC is the torn-response defense: a response cut
+mid-``sendall`` by a server kill must be a COUNTED rejection at the
+client, never a silently-wrong action batch.
+
+    request  0xD4E2: !BIHHI  flags, req_id, n_rows, obs_dim, crc32
+             [16-byte trace ext ``!Qd`` (trace id, birth ts) if flags&1]
+             payload: float32 obs rows [n_rows, obs_dim]
+    response 0xD4E3: !BIIIHHI status, req_id, generation, version,
+                              n_rows, act_dim, crc32
+             payload: float32 action rows [n_rows, act_dim] (OK only)
+
+Status codes: OK (actions attached), NO_PARAMS (server adopted nothing
+yet — the client falls back to its warmup policy), BAD_REQUEST (the
+server could not trust the request frame; req_id echoed from the
+header so the caller can fail that one request instead of the
+connection). The response carries the serving (generation, version)
+pair so a lane can observe exactly which fenced snapshot acted for it.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+import numpy as np
+
+
+class ProtocolError(RuntimeError):
+    """Malformed serving frame (bad magic, truncation, size mismatch).
+
+    Deliberately NOT the transport module's ProtocolError: importing
+    ``distributed.transport`` here would close an import cycle through
+    ``distributed/__init__`` -> ``actor`` -> ``serving.client``. Callers
+    that speak both planes catch both types explicitly."""
+
+
+MAGIC_REQUEST = 0xD4E2
+MAGIC_RESPONSE = 0xD4E3
+
+# Outer frame header, shared with every other plane: (magic, body_len).
+HEADER = struct.Struct("!II")
+REQ_HEADER = struct.Struct("!BIHHI")
+RSP_HEADER = struct.Struct("!BIIIHHI")
+TRACE_EXT = struct.Struct("!Qd")
+
+FLAG_TRACE = 0x01
+
+STATUS_OK = 0
+STATUS_NO_PARAMS = 1
+STATUS_BAD_REQUEST = 2
+
+# Requests are obs batches, responses action batches — both tiny next to
+# the transport plane's 64 MiB bound; a tighter cap catches a desynced
+# stream before it allocates gigabytes.
+MAX_BODY = 8 << 20
+
+
+class TornFrameError(ProtocolError):
+    """CRC mismatch: the payload bytes do not match the header's CRC.
+
+    Deterministic wire corruption (torn write across a server kill, or
+    injected chaos) — the caller counts and REJECTS the frame; retrying
+    the same bytes can never succeed."""
+
+
+def encode_request(req_id: int, obs: np.ndarray,
+                   trace: tuple[int, float] | None = None) -> bytes:
+    """One action request frame for a [n_rows, obs_dim] float32 batch."""
+    obs = np.ascontiguousarray(obs, dtype=np.float32)
+    if obs.ndim != 2:
+        raise ValueError(f"obs must be [n_rows, obs_dim], got {obs.shape}")
+    n_rows, obs_dim = obs.shape
+    payload = obs.tobytes()
+    flags = FLAG_TRACE if trace is not None else 0
+    head = REQ_HEADER.pack(flags, req_id & 0xFFFFFFFF, n_rows, obs_dim,
+                           zlib.crc32(payload))
+    ext = TRACE_EXT.pack(trace[0], trace[1]) if trace is not None else b""
+    body = head + ext + payload
+    return HEADER.pack(MAGIC_REQUEST, len(body)) + body
+
+
+def decode_request(body: bytes) -> dict:
+    """Parse a request body; raises TornFrameError on CRC mismatch (the
+    header fields are still returned inside the exception's ``.meta`` so
+    the server can echo the req_id in a BAD_REQUEST response)."""
+    if len(body) < REQ_HEADER.size:
+        raise ProtocolError(f"request body too short ({len(body)} bytes)")
+    flags, req_id, n_rows, obs_dim, crc = REQ_HEADER.unpack_from(body)
+    off = REQ_HEADER.size
+    trace = None
+    if flags & FLAG_TRACE:
+        if len(body) < off + TRACE_EXT.size:
+            raise ProtocolError("request trace extension truncated")
+        trace = TRACE_EXT.unpack_from(body, off)
+        off += TRACE_EXT.size
+    payload = body[off:]
+    if len(payload) != 4 * n_rows * obs_dim:
+        raise ProtocolError(
+            f"request payload {len(payload)}B != {4 * n_rows * obs_dim}B "
+            f"for [{n_rows}, {obs_dim}] f32")
+    if zlib.crc32(payload) != crc:
+        err = TornFrameError(f"request {req_id} failed CRC")
+        err.meta = {"req_id": req_id}
+        raise err
+    obs = np.frombuffer(payload, np.float32).reshape(n_rows, obs_dim)
+    return {"req_id": req_id, "obs": obs, "trace": trace}
+
+
+def encode_response(req_id: int, status: int, generation: int, version: int,
+                    actions: np.ndarray | None) -> bytes:
+    """One response frame; ``actions`` is required iff status == OK."""
+    if status == STATUS_OK:
+        actions = np.ascontiguousarray(actions, dtype=np.float32)
+        n_rows, act_dim = actions.shape
+        payload = actions.tobytes()
+    else:
+        n_rows = act_dim = 0
+        payload = b""
+    head = RSP_HEADER.pack(status, req_id & 0xFFFFFFFF,
+                           generation & 0xFFFFFFFF, version & 0xFFFFFFFF,
+                           n_rows, act_dim, zlib.crc32(payload))
+    body = head + payload
+    return HEADER.pack(MAGIC_RESPONSE, len(body)) + body
+
+
+def decode_response(body: bytes) -> dict:
+    """Parse a response body; TornFrameError on CRC mismatch — the
+    client counts it and treats the request as failed (degrading to its
+    local fallback), never acts on the corrupt rows."""
+    if len(body) < RSP_HEADER.size:
+        raise ProtocolError(f"response body too short ({len(body)} bytes)")
+    status, req_id, generation, version, n_rows, act_dim, crc = \
+        RSP_HEADER.unpack_from(body)
+    payload = body[RSP_HEADER.size:]
+    if status == STATUS_OK and len(payload) != 4 * n_rows * act_dim:
+        raise ProtocolError(
+            f"response payload {len(payload)}B != {4 * n_rows * act_dim}B")
+    if zlib.crc32(payload) != crc:
+        raise TornFrameError(f"response {req_id} failed CRC")
+    actions = (np.frombuffer(payload, np.float32).reshape(n_rows, act_dim)
+               if status == STATUS_OK else None)
+    return {"req_id": req_id, "status": status, "generation": generation,
+            "version": version, "actions": actions}
+
+
+def read_frame(sock, expect_magic: int, recv_exact) -> bytes | None:
+    """Read one length-prefixed frame body off ``sock`` (None on clean
+    EOF). ``recv_exact`` is injected so client and server share the
+    transport module's socket-read discipline without importing its
+    private helper here."""
+    head = recv_exact(sock, HEADER.size)
+    if head is None:
+        return None
+    magic, body_len = HEADER.unpack(head)
+    if magic != expect_magic:
+        raise ProtocolError(f"bad serving magic 0x{magic:X} "
+                            f"(want 0x{expect_magic:X})")
+    if body_len > MAX_BODY:
+        raise ProtocolError(f"serving body {body_len}B exceeds {MAX_BODY}B")
+    body = recv_exact(sock, body_len)
+    if body is None:
+        raise ProtocolError("peer closed mid-frame")
+    return body

@@ -1,0 +1,425 @@
+"""PolicyInferenceServer: continuous-batching action inference.
+
+The serving half of ROADMAP direction #2 ("Accelerated Methods for Deep
+RL", arXiv 1803.02811): instead of every actor paying its own batch-E
+jit dispatch, many lanes send obs batches over the serving wire
+(``serving.protocol``) and a single batcher thread coalesces whatever
+arrived inside a bounded window into ONE device dispatch. Three rules
+keep it production-shaped:
+
+- **Bounded window, never a stall.** The batcher waits at most
+  ``batch_window_s`` after the first pending request (or until
+  ``max_batch_rows`` accumulate) — latency is capped by construction,
+  and an idle server burns a condition wait, not a spin.
+- **Padded power-of-two buckets.** The fused row batch is padded to the
+  next power of two before dispatch, so a steady state serves from a
+  handful of compiled shapes instead of recompiling per occupancy
+  (``batch_occupancy`` tracks the honest fill ratio).
+- **Fenced freshness.** A refresher thread adopts (generation, version)
+  snapshots from the ``WeightStore`` monotonically — a regression
+  without a generation bump is a COUNTED rejection (``fenced_rejected``)
+  — and every response carries the pair that produced it. The freshness
+  SLA is declared, not implied: ``staleness_s`` (now - published_ts of
+  the adopted snapshot) is exported, and a batch served beyond
+  ``sla_staleness_s`` increments ``sla_breaches``.
+
+Obs rows arrive ALREADY normalized (the legacy ``_explore_actions``
+convention — the normalizer view lives with the lane, refreshed off the
+weight channel); the server computes greedy mu only, exploration noise
+stays client-side so a shared server never correlates lanes.
+
+Locking: all serving state (pending deque, adopted params, counters)
+lives under the declared ``pserve``-tier condition ``_pserve_cond``
+(below ``wserve``, above ``wstore`` — the refresher's store snapshot is
+taken OUTSIDE the condition, so the only nesting is none at all).
+Responses are written outside the condition; a connection has at most
+one in-flight request (the client protocol is send→wait), so the single
+batcher thread is the only response writer per socket.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from collections import deque
+
+import jax.numpy as jnp
+import numpy as np
+
+from d4pg_tpu.core.locking import TieredCondition
+from d4pg_tpu.learner.state import D4PGConfig
+from d4pg_tpu.learner.update import act_deterministic
+from d4pg_tpu.obs.flight import record_event
+from d4pg_tpu.obs.registry import REGISTRY, percentile_summary
+from d4pg_tpu.obs.trace import RECORDER
+from d4pg_tpu.distributed.transport import (
+    ConnRegistry,
+    _recv_exact,
+    server_handshake,
+)
+from d4pg_tpu.serving import protocol
+from d4pg_tpu.serving.client import act_device_scope, put_params_on, \
+    resolve_act_device
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+class ServingChaos:
+    """Deterministic response corruption for the serving wire.
+
+    Flips one payload byte AFTER the CRC is computed, at a seeded rate —
+    the frame still parses structurally (framing intact, stream not
+    desynced) but the CRC check must reject it. ``torn_req_ids`` is the
+    injection ledger the chaos oracle intersects with the clients'
+    acceptance ledgers: torn ∩ accepted must be empty."""
+
+    def __init__(self, torn_response_rate: float = 0.0, seed: int = 0):
+        self.torn_response_rate = float(torn_response_rate)
+        self._rng = np.random.default_rng((seed << 4) ^ 0xD4E3)
+        self._mu = threading.Lock()
+        self.torn_req_ids: set[int] = set()
+        self.torn_injected = 0
+
+    def maybe_tear(self, req_id: int, frame: bytes) -> bytes:
+        body_payload_off = protocol.HEADER.size + protocol.RSP_HEADER.size
+        if (self.torn_response_rate <= 0.0
+                or len(frame) <= body_payload_off
+                or self._rng.random() >= self.torn_response_rate):
+            return frame
+        torn = bytearray(frame)
+        idx = body_payload_off + int(
+            self._rng.integers(0, len(frame) - body_payload_off))
+        torn[idx] ^= 0xFF
+        with self._mu:
+            self.torn_req_ids.add(req_id)
+            self.torn_injected += 1
+        return bytes(torn)
+
+
+class PolicyInferenceServer(ConnRegistry):
+    """Continuous-batching greedy-action service over one port."""
+
+    def __init__(
+        self,
+        config: D4PGConfig,
+        weights,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        secret: str | None = None,
+        batch_window_s: float = 0.002,
+        max_batch_rows: int = 256,
+        sla_staleness_s: float = 1.0,
+        refresh_interval_s: float = 0.02,
+        device: str = "cpu",
+        chaos: ServingChaos | None = None,
+    ):
+        super().__init__()
+        self.config = config
+        self._weights = weights
+        self._secret = secret
+        self.batch_window_s = float(batch_window_s)
+        self.max_batch_rows = int(max_batch_rows)
+        self.sla_staleness_s = float(sla_staleness_s)
+        self.refresh_interval_s = float(refresh_interval_s)
+        self.chaos = chaos
+        self._obs_dim = int(config.obs_dim)
+        self._act_device = resolve_act_device(device)
+        # ---- serving state, all under the declared pserve tier ----
+        self._pserve_cond = TieredCondition("pserve")
+        self._pending: deque = deque()  # (conn, req dict, enqueue_ts)
+        self._params = None
+        self._generation = 0
+        self._version = 0
+        self._published_ts: float | None = None
+        self._occupancy: deque = deque(maxlen=4096)
+        self._latency_ms: deque = deque(maxlen=4096)
+        self._batch_rows: deque = deque(maxlen=4096)
+        self.stats = {
+            "requests": 0, "responses_ok": 0, "batches": 0, "rows": 0,
+            "padded_rows": 0, "no_params": 0, "bad_requests": 0,
+            "write_errors": 0, "adoptions": 0, "fenced_rejected": 0,
+            "sla_breaches": 0,
+        }
+        # ---- wiring ----
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, port))
+        self._server.listen()
+        self.port = self._server.getsockname()[1]
+        self._stop = threading.Event()
+        self._conn_threads: list[threading.Thread] = []
+        self._accept_thread = threading.Thread(target=self._accept,
+                                               daemon=True)
+        self._batch_thread = threading.Thread(target=self._batcher,
+                                              daemon=True)
+        self._refresh_thread = threading.Thread(target=self._refresher,
+                                                daemon=True)
+        REGISTRY.register_provider("serving", self.serving_stats)
+        self._accept_thread.start()
+        self._batch_thread.start()
+        self._refresh_thread.start()
+
+    # -- param freshness ----------------------------------------------------
+    def _refresher(self) -> None:
+        while not self._stop.is_set():
+            self.refresh_once()
+            self._stop.wait(self.refresh_interval_s)
+
+    def refresh_once(self) -> bool:
+        """One adoption attempt against the store's current snapshot.
+        The store read and the device placement happen OUTSIDE the
+        serving condition (no lock nesting at all); only the swap is
+        under it."""
+        snap = self._weights.snapshot_ex()
+        if snap["params"] is None:
+            return False
+        gen, ver = int(snap["generation"]), int(snap["version"])
+        with self._pserve_cond:
+            newer = (gen > self._generation
+                     or (gen == self._generation and ver > self._version))
+            current = (gen, ver) == (self._generation, self._version)
+            if not newer:
+                if not current and self._params is not None:
+                    # the fence: a (gen, version) behind what we already
+                    # serve is a rewind without a generation bump —
+                    # never adopted, always counted
+                    self.stats["fenced_rejected"] += 1
+                return False
+        params = put_params_on(self._act_device, snap["params"])
+        with self._pserve_cond:
+            # re-check under the cond: another refresh_once may have
+            # adopted something newer while we were placing arrays
+            if (gen > self._generation
+                    or (gen == self._generation and ver > self._version)):
+                self._params = params
+                self._generation, self._version = gen, ver
+                self._published_ts = snap.get("published_ts") \
+                    or time.monotonic()
+                self.stats["adoptions"] += 1
+                return True
+        return False
+
+    def staleness_s(self) -> float | None:
+        """Age of the served snapshot against the SLA clock."""
+        with self._pserve_cond:
+            if self._published_ts is None:
+                return None
+            return time.monotonic() - self._published_ts
+
+    # -- connections --------------------------------------------------------
+    def _accept(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._server.settimeout(0.2)
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            self._register_conn(conn)
+            self._conn_threads = [t for t in self._conn_threads
+                                  if t.is_alive()]
+            t = threading.Thread(target=self._reader, args=(conn,),
+                                 daemon=True)
+            self._conn_threads.append(t)
+            t.start()
+
+    def _reader(self, conn: socket.socket) -> None:
+        """Per-connection request pump: decode, validate, enqueue."""
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if not server_handshake(conn, self._secret):
+                return
+            conn.settimeout(None)
+            while not self._stop.is_set():
+                body = protocol.read_frame(conn, protocol.MAGIC_REQUEST,
+                                           _recv_exact)
+                if body is None:
+                    return
+                try:
+                    req = protocol.decode_request(body)
+                except protocol.TornFrameError as e:
+                    # corrupt payload with a readable header: fail the
+                    # one request, keep the connection
+                    self._respond_error(conn, e.meta["req_id"],
+                                        protocol.STATUS_BAD_REQUEST)
+                    continue
+                if req["obs"].shape[1] != self._obs_dim:
+                    self._respond_error(conn, req["req_id"],
+                                        protocol.STATUS_BAD_REQUEST)
+                    continue
+                now = time.monotonic()
+                if req["trace"] is not None:
+                    tid, birth = req["trace"]
+                    RECORDER.begin(tid, birth)
+                    RECORDER.record_span(tid, "admission", now)
+                with self._pserve_cond:
+                    self.stats["requests"] += 1
+                    self._pending.append((conn, req, now))
+                    self._pserve_cond.notify()
+        except (OSError, protocol.ProtocolError):
+            return  # peer died or desynced; the lane reconnects
+        finally:
+            self._unregister_conn(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _respond_error(self, conn: socket.socket, req_id: int,
+                       status: int) -> None:
+        with self._pserve_cond:
+            self.stats["bad_requests"] += 1
+        try:
+            conn.sendall(protocol.encode_response(req_id, status, 0, 0, None))
+        except OSError:
+            with self._pserve_cond:
+                self.stats["write_errors"] += 1
+
+    # -- the batcher --------------------------------------------------------
+    def _pop_batch_locked(self) -> list:  # jaxlint: guarded-by=_pserve_cond
+        """FIFO-pop pending requests up to the row budget (at least one:
+        a single oversized request serves alone at its own bucket)."""
+        batch, rows = [], 0
+        while self._pending:
+            n = self._pending[0][1]["obs"].shape[0]
+            if batch and rows + n > self.max_batch_rows:
+                break
+            batch.append(self._pending.popleft())
+            rows += n
+        return batch
+
+    def _batcher(self) -> None:
+        while True:
+            with self._pserve_cond:
+                while not self._pending and not self._stop.is_set():
+                    self._pserve_cond.wait(0.1)
+                if self._stop.is_set():
+                    return
+                # continuous-batching window: the FIRST pending request
+                # opens it; later arrivals ride along until it closes or
+                # the row budget fills
+                deadline = time.monotonic() + self.batch_window_s
+                while (sum(r[1]["obs"].shape[0] for r in self._pending)
+                        < self.max_batch_rows):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or self._stop.is_set():
+                        break
+                    self._pserve_cond.wait(remaining)
+                batch = self._pop_batch_locked()
+                params = self._params
+                gen, ver = self._generation, self._version
+                pub_ts = self._published_ts
+            if batch:
+                self._serve_batch(batch, params, gen, ver, pub_ts)
+
+    def _serve_batch(self, batch: list, params, gen: int, ver: int,
+                     pub_ts: float | None) -> None:
+        """One fused dispatch for a popped batch; runs OUTSIDE the
+        serving condition (compute and socket writes never hold it)."""
+        rows = sum(req["obs"].shape[0] for _, req, _ in batch)
+        if params is None:
+            for conn, req, _ in batch:
+                self._write_response(conn, req, protocol.encode_response(
+                    req["req_id"], protocol.STATUS_NO_PARAMS, gen, ver, None))
+            with self._pserve_cond:
+                self.stats["batches"] += 1
+                self.stats["no_params"] += len(batch)
+            return
+        fused = np.concatenate([req["obs"] for _, req, _ in batch], axis=0)
+        bucket = max(_next_pow2(rows), 1)
+        if bucket > rows:
+            fused = np.concatenate(
+                [fused, np.zeros((bucket - rows, self._obs_dim), np.float32)],
+                axis=0)
+        with act_device_scope(self._act_device):
+            mu = np.asarray(
+                act_deterministic(self.config, params, jnp.asarray(fused)))
+        now = time.monotonic()
+        ok = 0
+        off = 0
+        for conn, req, t_enq in batch:
+            n = req["obs"].shape[0]
+            frame = protocol.encode_response(
+                req["req_id"], protocol.STATUS_OK, gen, ver, mu[off:off + n])
+            off += n
+            if self.chaos is not None:
+                frame = self.chaos.maybe_tear(req["req_id"], frame)
+            if self._write_response(conn, req, frame):
+                ok += 1
+            self._latency_ms.append(1e3 * (now - t_enq))
+        breach = (pub_ts is not None
+                  and (now - pub_ts) > self.sla_staleness_s)
+        with self._pserve_cond:
+            self.stats["batches"] += 1
+            self.stats["rows"] += rows
+            self.stats["padded_rows"] += bucket - rows
+            self.stats["responses_ok"] += ok
+            if breach:
+                self.stats["sla_breaches"] += 1
+            self._occupancy.append(rows / bucket)
+            self._batch_rows.append(rows)
+
+    def _write_response(self, conn: socket.socket, req: dict,
+                        frame: bytes) -> bool:
+        try:
+            conn.sendall(frame)
+        except OSError:
+            with self._pserve_cond:
+                self.stats["write_errors"] += 1
+            if req["trace"] is not None:
+                RECORDER.terminal_shed(req["trace"][0])
+            return False
+        if req["trace"] is not None:
+            RECORDER.record_span(req["trace"][0], "commit")
+        return True
+
+    # -- observability ------------------------------------------------------
+    def serving_stats(self) -> dict:
+        """The ``serving`` obs-registry provider: one consistent snapshot
+        under the serving condition (the PR-4 rule: counters read under
+        the lock that writes them)."""
+        with self._pserve_cond:
+            out = dict(self.stats)
+            out["queue_depth"] = len(self._pending)
+            out["generation"] = self._generation
+            out["version"] = self._version
+            out["staleness_s"] = (
+                None if self._published_ts is None
+                else round(time.monotonic() - self._published_ts, 6))
+            out["sla_staleness_s"] = self.sla_staleness_s
+            out["batch_occupancy"] = percentile_summary(list(self._occupancy))
+            out["batch_rows"] = percentile_summary(list(self._batch_rows))
+            out["latency_ms"] = percentile_summary(list(self._latency_ms))
+        if self.chaos is not None:
+            out["torn_injected"] = self.chaos.torn_injected
+        return out
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._pserve_cond:
+            self._pserve_cond.notify_all()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        self._shutdown_conns()
+        self._batch_thread.join(timeout=5.0)
+        self._refresh_thread.join(timeout=5.0)
+        self._accept_thread.join(timeout=5.0)
+        for t in self._conn_threads:
+            t.join(timeout=2.0)
+        # pending requests die with the server: traced ones get their
+        # terminal so the zero-orphan invariant survives a kill
+        with self._pserve_cond:
+            leftovers = list(self._pending)
+            self._pending.clear()
+        for _, req, _ in leftovers:
+            if req["trace"] is not None:
+                RECORDER.terminal_shed(req["trace"][0])
+        record_event("serving_server_closed", port=self.port,
+                     requests=self.stats["requests"])
+        REGISTRY.unregister_provider("serving", self.serving_stats)

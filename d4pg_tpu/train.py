@@ -682,6 +682,24 @@ def train(cfg: ExperimentConfig) -> dict:
                                           window=cfg.weight_window)
         print(f"serving: transitions :{receiver.port} weights :{weight_server.port}",
               flush=True)
+    policy_server = None
+    if cfg.serve_policy:
+        # Serving plane (docs/architecture.md "Serving plane"): remote
+        # actors launched with --policy_port stream obs batches here and
+        # get greedy mu back from ONE fused dispatch per batching
+        # window; the refresher adopts (generation, version) snapshots
+        # from the same store the weight plane broadcasts, under the
+        # declared staleness SLA.
+        from d4pg_tpu.serving import PolicyInferenceServer
+
+        policy_server = PolicyInferenceServer(
+            config, weights, host=cfg.serve_host,
+            port=cfg.serve_policy_port,
+            secret=cfg.serve_secret or None,
+            batch_window_s=cfg.serve_policy_window_s,
+            max_batch_rows=cfg.serve_policy_max_rows,
+            sla_staleness_s=cfg.serve_policy_sla_s)
+        print(f"serving: policy :{policy_server.port}", flush=True)
     if cfg.actor_procs > 0:
         # Real process-level local parallelism (the reference's mp.Process
         # fan-out, main.py:399-405, done over the TCP plane): each process
@@ -1275,6 +1293,8 @@ def train(cfg: ExperimentConfig) -> dict:
         receiver.close()
     if weight_server is not None:
         weight_server.close()
+    if policy_server is not None:
+        policy_server.close()
     service.close()
     for actor in actors:
         if cfg.her:

@@ -101,6 +101,74 @@ def test_env_pool_autoreset_and_stats():
     pool.close()
 
 
+def test_env_pool_reset_on_done_is_per_env():
+    """Mixed horizons: only the done env is autoreset on its done tick —
+    its ``obs`` row diverges from ``final_obs`` while live envs' rows
+    stay identical (the serving lanes batch many envs through one
+    request, so a pool-wide reset would corrupt the other lanes' rows)."""
+    pool = EnvPool([lambda: PointMassEnv(horizon=5, seed=0),
+                    lambda: PointMassEnv(horizon=9, seed=1)], seed=3)
+    pool.reset()
+    for _ in range(5):
+        out = pool.step(np.full((2, 2), 0.3, np.float32))
+    assert out.truncated.tolist() == [True, False]
+    assert not np.allclose(out.obs[0], out.final_obs[0])
+    np.testing.assert_array_equal(out.obs[1], out.final_obs[1])
+    assert pool.episode_lengths == [5]
+    pool.close()
+
+
+def test_env_pool_seed_determinism():
+    """Two pools built from the same (ctor seeds, pool seed) reproduce
+    the same trajectory under the same actions, and a second reset()
+    replays the same initial obs (reset re-seeds env i with seed+i)."""
+    def build():
+        return EnvPool([lambda s=i: PointMassEnv(horizon=30, seed=s)
+                        for i in range(3)], seed=7)
+
+    a, b = build(), build()
+    rng = np.random.default_rng(4)
+    actions = rng.uniform(-1, 1, (12, 3, 2)).astype(np.float32)
+    first = a.reset()
+    np.testing.assert_array_equal(first, b.reset())
+    for t in range(12):
+        oa, ob = a.step(actions[t]), b.step(actions[t])
+        np.testing.assert_array_equal(oa.obs, ob.obs)
+        np.testing.assert_array_equal(oa.reward, ob.reward)
+        np.testing.assert_array_equal(oa.final_obs, ob.final_obs)
+    np.testing.assert_array_equal(a.reset(), first)
+    a.close(), b.close()
+
+
+def test_env_pool_single_env_matches_scalar():
+    """1-env pool == the raw env stepped by hand (the serving refactor's
+    E=1 anchor): same seed path, identical obs/reward/done stream, with
+    the pool's tanh->space action rescale applied explicitly."""
+    from d4pg_tpu.envs import rescale_action
+
+    pool = EnvPool([lambda: PointMassEnv(horizon=8, seed=2)], seed=13)
+    env = PointMassEnv(horizon=8, seed=2)
+    obs_p = pool.reset()
+    obs_s, _ = env.reset(seed=13)  # pool seeds env 0 with seed + 0
+    np.testing.assert_array_equal(obs_p[0], np.float32(obs_s))
+    rng = np.random.default_rng(1)
+    low = np.asarray(env.action_space.low, np.float32)
+    high = np.asarray(env.action_space.high, np.float32)
+    for t in range(10):  # crosses the horizon-8 autoreset boundary
+        a = rng.uniform(-1, 1, (1, 2)).astype(np.float32)
+        out = pool.step(a)
+        obs_s, r, term, trunc, _ = env.step(
+            rescale_action(a, low, high)[0])
+        np.testing.assert_array_equal(out.final_obs[0], np.float32(obs_s))
+        assert out.reward[0] == np.float32(r)
+        assert bool(out.terminated[0]) == term
+        assert bool(out.truncated[0]) == trunc
+        if term or trunc:
+            obs_s, _ = env.reset()
+        np.testing.assert_array_equal(out.obs[0], np.float32(obs_s))
+    pool.close()
+
+
 def test_fake_goal_env_contract():
     env = FakeGoalEnv(seed=3)
     obs, _ = env.reset(seed=3)

@@ -125,7 +125,8 @@ def test_lock_graph_clean_over_package():
                        ("_commit_cond", "commit"), ("cond", "shard"),
                        ("_ring_locks", "ring"), ("_relay_lock", "wrelay"),
                        ("_frame_lock", "wserve"), ("_store_lock", "wstore"),
-                       ("_replica_lock", "replica"), ("_agg_cond", "agg")):
+                       ("_replica_lock", "replica"), ("_agg_cond", "agg"),
+                       ("_pserve_cond", "pserve")):
         assert lock in graph.nodes, sorted(graph.nodes)
         assert graph.nodes[lock] == tier
     # every edge between tier-labeled locks DESCENDS the hierarchy

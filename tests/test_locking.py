@@ -32,11 +32,12 @@ def debug_mode():
 
 def test_hierarchy_table_shape():
     # outermost first, strictly decreasing: the five ingest-plane tiers
-    # plus the multi-learner pair (replica > aggregator) and the weight
-    # plane's three (relay > server cache > store)
+    # plus the multi-learner pair (replica > aggregator), the weight
+    # plane's three (relay > server cache > store), and the serving
+    # plane's condition wedged between the weight server and the store
     assert list(HIERARCHY) == ["service", "buffer", "replica", "agg",
-                               "commit", "wrelay", "wserve", "wstore",
-                               "shard", "ring"]
+                               "commit", "wrelay", "wserve", "pserve",
+                               "wstore", "shard", "ring"]
     tiers = list(HIERARCHY.values())
     assert tiers == sorted(tiers, reverse=True)
     assert len(set(tiers)) == len(tiers)

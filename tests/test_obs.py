@@ -519,3 +519,28 @@ def test_registry_export_covers_weight_plane():
         assert "delta_hit_rate" in out
     finally:
         srv.close()
+
+
+def test_registry_export_covers_serving_plane():
+    """A live PolicyInferenceServer registers the 'serving' provider
+    (queue depth, batch occupancy/latency histograms, the staleness-SLA
+    pair) and unregisters it on close — per-instance lifetime, like
+    'ingest', not module-lifetime like 'weights'."""
+    from d4pg_tpu.distributed.weights import WeightStore
+    from d4pg_tpu.learner.state import D4PGConfig
+    from d4pg_tpu.serving import PolicyInferenceServer
+
+    cfg = D4PGConfig(obs_dim=4, act_dim=2, n_atoms=11, hidden=(16,))
+    srv = PolicyInferenceServer(cfg, WeightStore())
+    try:
+        out = REGISTRY.export()["serving"]
+        assert out["queue_depth"] == 0
+        assert out["sla_staleness_s"] == srv.sla_staleness_s
+        for block in ("batch_occupancy", "batch_rows", "latency_ms"):
+            assert "p95" in out[block]
+        for counter in ("requests", "batches", "adoptions",
+                        "fenced_rejected", "sla_breaches"):
+            assert counter in out
+    finally:
+        srv.close()
+    assert "serving" not in REGISTRY.export()

@@ -1,0 +1,399 @@
+"""Serving-plane tests (``d4pg_tpu/serving``).
+
+The acceptance set for the continuous-batching inference service: wire
+protocol framing + CRC torn-rejection, the 1-env lane-vs-legacy-actor
+bitwise parity oracle (the refactor's safety net), batching/padding
+correctness against a direct ``act_deterministic`` call, fenced
+(generation, version) adoption, the client degradation ladder
+(timeout -> cached params -> uniform warmup, every rung counted), a
+small server-kill chaos smoke with all three run-gating oracles, and
+the bench-artifact serving schema gate.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import socket
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from d4pg_tpu.distributed.transport import _recv_exact
+from d4pg_tpu.distributed.weights import WeightStore
+from d4pg_tpu.envs import EnvPool, PointMassEnv
+from d4pg_tpu.learner.state import D4PGConfig, init_state
+from d4pg_tpu.learner.update import act_deterministic
+from d4pg_tpu.serving import (
+    ActorConfig,
+    LocalPolicyClient,
+    PolicyInferenceServer,
+    RemotePolicyClient,
+    ServingChaos,
+    VectorActorLane,
+)
+from d4pg_tpu.serving import protocol
+
+pytestmark = pytest.mark.serving
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = D4PGConfig(obs_dim=4, act_dim=2, v_min=-50.0, v_max=0.0,
+                 n_atoms=11, hidden=(32, 32))
+
+
+def _published_store(seed: int = 0) -> WeightStore:
+    store = WeightStore()
+    params = init_state(CFG, jax.random.key(seed)).actor_params
+    store.publish(params, step=1, to_host=False)
+    return store
+
+
+# ------------------------------------------------------ wire protocol --
+
+
+def test_protocol_request_roundtrip():
+    obs = np.arange(12, dtype=np.float32).reshape(3, 4)
+    frame = protocol.encode_request(42, obs, trace=(7, 1.5))
+    magic, body_len = protocol.HEADER.unpack(frame[:protocol.HEADER.size])
+    assert magic == protocol.MAGIC_REQUEST
+    body = frame[protocol.HEADER.size:]
+    assert len(body) == body_len
+    req = protocol.decode_request(body)
+    assert req["req_id"] == 42
+    assert req["trace"] == (7, 1.5)
+    np.testing.assert_array_equal(req["obs"], obs)
+
+
+def test_protocol_response_roundtrip_and_statuses():
+    acts = np.linspace(-1, 1, 8, dtype=np.float32).reshape(4, 2)
+    body = protocol.encode_response(9, protocol.STATUS_OK, 2, 17,
+                                    acts)[protocol.HEADER.size:]
+    rsp = protocol.decode_response(body)
+    assert (rsp["status"], rsp["generation"], rsp["version"]) == (0, 2, 17)
+    np.testing.assert_array_equal(rsp["actions"], acts)
+    # error statuses carry no payload but echo the req_id
+    body = protocol.encode_response(9, protocol.STATUS_NO_PARAMS, 0, 0,
+                                    None)[protocol.HEADER.size:]
+    rsp = protocol.decode_response(body)
+    assert rsp["status"] == protocol.STATUS_NO_PARAMS
+    assert rsp["actions"] is None and rsp["req_id"] == 9
+
+
+def test_protocol_torn_frames_rejected():
+    obs = np.ones((2, 4), np.float32)
+    body = bytearray(protocol.encode_request(5, obs)[protocol.HEADER.size:])
+    body[-1] ^= 0xFF
+    with pytest.raises(protocol.TornFrameError) as ei:
+        protocol.decode_request(bytes(body))
+    assert ei.value.meta["req_id"] == 5  # server echoes it as BAD_REQUEST
+    acts = np.ones((2, 2), np.float32)
+    body = bytearray(protocol.encode_response(
+        6, protocol.STATUS_OK, 0, 1, acts)[protocol.HEADER.size:])
+    body[-2] ^= 0x01
+    with pytest.raises(protocol.TornFrameError):
+        protocol.decode_response(bytes(body))
+
+
+def test_protocol_bad_magic_and_truncation():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(protocol.HEADER.pack(0xBEEF, 4) + b"xxxx")
+        with pytest.raises(protocol.ProtocolError, match="magic"):
+            protocol.read_frame(b, protocol.MAGIC_REQUEST, _recv_exact)
+    finally:
+        a.close()
+        b.close()
+    a, b = socket.socketpair()
+    try:
+        # frame claims 64 body bytes but the peer dies after 5
+        a.sendall(protocol.HEADER.pack(protocol.MAGIC_REQUEST, 64) + b"short")
+        a.close()
+        with pytest.raises(protocol.ProtocolError, match="mid-frame"):
+            protocol.read_frame(b, protocol.MAGIC_REQUEST, _recv_exact)
+    finally:
+        b.close()
+    with pytest.raises(protocol.ProtocolError, match="too short"):
+        protocol.decode_request(b"\x00")
+
+
+# ------------------------------------------------- the parity oracle ---
+
+
+class _Capture:
+    """ReplayService-shaped sink recording every folded batch."""
+
+    def __init__(self):
+        self.batches = []
+
+    def add(self, batch, actor_id=None, block=True, timeout=None,
+            count_env_steps=True):
+        self.batches.append(batch)
+        return True
+
+
+def _stream(sink: _Capture) -> dict:
+    return {f: np.concatenate([getattr(b, f) for b in sink.batches])
+            for f in sink.batches[0]._fields}
+
+
+def test_lane_reproduces_legacy_actor_bitwise():
+    """THE refactor oracle: a 1-env VectorActorLane with an in-process
+    LocalPolicyClient must emit the legacy ``ActorWorker``'s transition
+    stream seed-for-seed, bitwise — acting, noise, epsilon decay and
+    n-step folding all line up or the serving split changed training."""
+    from d4pg_tpu.distributed.actor import ActorWorker
+
+    store = _published_store()
+    actor_cfg = ActorConfig(n_step=3, noise="gaussian", weight_poll_every=4)
+
+    def pool():
+        return EnvPool([lambda: PointMassEnv(horizon=20, seed=11)], seed=5)
+
+    legacy_sink, lane_sink = _Capture(), _Capture()
+    legacy = ActorWorker("a0", CFG, actor_cfg, pool(), legacy_sink, store,
+                         seed=9)
+    legacy.run(64)
+    lane = VectorActorLane(
+        "a0", CFG, actor_cfg, pool(), lane_sink,
+        policy=LocalPolicyClient(CFG, actor_cfg, store, seed=9))
+    lane.run(64)
+    assert legacy.env_steps == lane.env_steps == 64
+    a, b = _stream(legacy_sink), _stream(lane_sink)
+    for field in a:
+        assert a[field].dtype == b[field].dtype
+        np.testing.assert_array_equal(a[field], b[field], err_msg=field)
+
+
+# --------------------------------------------------- batching server ---
+
+
+def test_server_batches_match_direct_dispatch():
+    """Served actions equal a direct ``act_deterministic`` call (within
+    float tolerance — padding to a power-of-two bucket must not leak
+    into real rows), and concurrent lane requests coalesce into fewer
+    dispatches than requests."""
+    store = _published_store()
+    server = PolicyInferenceServer(CFG, store, batch_window_s=0.05,
+                                   max_batch_rows=64)
+    clients = [RemotePolicyClient(CFG, ActorConfig(noise="gaussian"),
+                                  "127.0.0.1", server.port, lane_id=i,
+                                  seed=i, timeout=5.0)
+               for i in range(4)]
+    try:
+        # wait for the refresher to adopt the published snapshot
+        deadline = time.monotonic() + 5.0
+        while server.serving_stats()["version"] == 0:
+            assert time.monotonic() < deadline, "refresher never adopted"
+            time.sleep(0.01)
+        rng = np.random.default_rng(0)
+        obs = [rng.standard_normal((3 + i, 4)).astype(np.float32)
+               for i in range(4)]
+        got = [None] * 4
+        threads = [threading.Thread(
+            target=lambda i=i: got.__setitem__(
+                i, clients[i].greedy_actions(obs[i])))
+            for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10.0)
+        _, params = store.get_if_newer(0)
+        for i in range(4):
+            want = np.asarray(act_deterministic(CFG, params, obs[i]))
+            np.testing.assert_allclose(got[i], want, rtol=1e-5, atol=1e-6)
+        stats = server.serving_stats()
+        assert stats["rows"] == sum(o.shape[0] for o in obs)
+        # 4 requests inside one 50 ms window: genuinely coalesced
+        assert stats["batches"] < stats["requests"]
+        assert stats["padded_rows"] > 0  # non-pow2 totals hit a bucket
+        assert 0 < stats["batch_occupancy"]["p50"] <= 1.0
+    finally:
+        for c in clients:
+            c.close()
+        server.close()
+
+
+class _ScriptedStore:
+    """snapshot_ex stub driving the refresher's fence by hand."""
+
+    def __init__(self):
+        self.snap = {"params": None, "version": 0, "step": 0,
+                     "generation": 0, "published_ts": time.monotonic(),
+                     "norm_stats": None}
+
+    def set(self, generation, version, params):
+        self.snap.update(generation=generation, version=version,
+                         params=params)
+
+    def snapshot_ex(self):
+        return dict(self.snap)
+
+
+def test_fenced_adoption_rejects_version_rewind():
+    store = _ScriptedStore()
+    server = PolicyInferenceServer(CFG, store, refresh_interval_s=3600.0)
+    params = init_state(CFG, jax.random.key(0)).actor_params
+    try:
+        assert server.refresh_once() is False  # nothing published yet
+        store.set(0, 5, params)
+        assert server.refresh_once() is True
+        # version rewind without a generation bump: NEVER adopted
+        store.set(0, 3, params)
+        assert server.refresh_once() is False
+        s = server.serving_stats()
+        assert s["version"] == 5 and s["fenced_rejected"] == 1
+        # a generation bump legitimizes a rewound version counter
+        store.set(1, 1, params)
+        assert server.refresh_once() is True
+        s = server.serving_stats()
+        assert (s["generation"], s["version"]) == (1, 1)
+        assert s["adoptions"] == 2
+    finally:
+        server.close()
+
+
+# ----------------------------------------------- degradation ladder ----
+
+
+def test_no_params_server_yields_counted_warmup():
+    server = PolicyInferenceServer(CFG, WeightStore(),
+                                   batch_window_s=0.001)
+    client = RemotePolicyClient(CFG, ActorConfig(noise="gaussian"),
+                                "127.0.0.1", server.port, timeout=5.0)
+    try:
+        acts = client.actions(np.zeros((3, 4), np.float32))
+        assert acts.shape == (3, 2)
+        assert (np.abs(acts) <= 1.0).all()
+        st = client.stats()
+        assert st["no_params"] == 1 and st["warmup_fallbacks"] == 1
+        assert st["served"] == 0
+    finally:
+        client.close()
+        server.close()
+
+
+def test_dead_server_falls_back_to_cached_params():
+    """Rung 3: no server at all -> local mu from the weights handle,
+    counted, never a stall."""
+    store = _published_store()
+    # grab a port with nothing listening behind it
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    client = RemotePolicyClient(CFG, ActorConfig(noise="gaussian"),
+                                "127.0.0.1", dead_port, timeout=0.2,
+                                connect_timeout=0.2, weights=store)
+    try:
+        obs = np.ones((2, 4), np.float32)
+        t0 = time.monotonic()
+        acts = client.actions(obs)
+        assert time.monotonic() - t0 < 2.0  # bounded, not a stall
+        st = client.stats()
+        assert st["fallbacks"] == 1 and st["served"] == 0
+        _, params = store.get_if_newer(0)
+        mu = np.asarray(act_deterministic(CFG, params, obs))
+        # greedy fallback + client-side exploration noise stays in range
+        assert (np.abs(acts) <= 1.0).all()
+        np.testing.assert_allclose(client.greedy_actions(obs), mu,
+                                   rtol=1e-5, atol=1e-6)
+    finally:
+        client.close()
+
+
+def test_torn_responses_rejected_then_fallback():
+    store = _published_store()
+    chaos = ServingChaos(torn_response_rate=1.0, seed=2)
+    server = PolicyInferenceServer(CFG, store, batch_window_s=0.001,
+                                   chaos=chaos)
+    client = RemotePolicyClient(CFG, ActorConfig(noise="gaussian"),
+                                "127.0.0.1", server.port, timeout=5.0,
+                                weights=store, record_ledger=True)
+    try:
+        deadline = time.monotonic() + 5.0
+        while server.serving_stats()["version"] == 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        acts = client.actions(np.zeros((2, 4), np.float32))
+        assert acts.shape == (2, 2)
+        st = client.stats()
+        assert st["torn_rejected"] == 1 and st["served"] == 0
+        assert st["fallbacks"] == 1
+        assert chaos.torn_injected == 1
+        # the chaos oracle in miniature: nothing torn was acted on
+        assert client.accepted_req_ids == set()
+    finally:
+        client.close()
+        server.close()
+
+
+# ------------------------------------------------------ chaos + gate ---
+
+
+@pytest.mark.fleet
+def test_serving_chaos_smoke():
+    """A small end-to-end chaos run must pass all three gating oracles
+    — torn-acceptance ledger, trace orphans, lock hierarchy — recover
+    from the server kill (MTTR measured), and count its degradation
+    instead of stalling. The full-size version is the bench artifact's
+    serving block."""
+    from d4pg_tpu.fleet.serving_chaos import (
+        ServingChaosConfig,
+        run_serving_chaos,
+    )
+
+    rep = run_serving_chaos(ServingChaosConfig(
+        n_lanes=2, envs_per_lane=2, duration_s=1.5, server_kills=1,
+        torn_prob=0.1, seed=3))
+    assert rep["server_kills"] == 1
+    assert rep["mttr_s"] and rep["mttr_s"][0] is not None
+    assert rep["torn"]["injected"] > 0
+    assert rep["torn"]["accepted"] == 0
+    assert rep["trace"]["orphans"] == 0
+    assert rep["hierarchy_violations"] == 0
+    assert rep["lanes_converged"] == 2
+    assert rep["served"] > 0 and rep["env_steps"] > 0
+    # the kill window degraded (counted), never stalled the lanes
+    assert (rep["fallbacks"] + rep["warmup_fallbacks"]
+            + rep["timeouts"] + rep["wire_errors"]) > 0
+    assert rep["ingest"]["env_steps"] > 0  # transitions rode the wire
+
+
+@pytest.mark.obs
+def test_fleet_artifact_serving_schema():
+    """The newest committed fleet artifact must carry the serving block:
+    the lane sweep, the batched-vs-unbatched pair with batched winning
+    on actions/s at equal lane count, and a >=1-server-kill chaos row
+    with all oracles clean — a later PR that drops any of it fails
+    tier-1 here."""
+    arts = sorted(glob.glob(os.path.join(
+        REPO_ROOT, "docs", "evidence", "fleet", "fleet_*.json")))
+    assert arts, "no committed fleet artifact"
+    with open(arts[-1]) as f:
+        artifact = json.load(f)
+    s = artifact.get("serving")
+    assert s, "newest fleet artifact lost its serving block"
+    assert s["metric"] == "fleet_serving" and s["schema"] == 1
+    assert len(s["sweep"]) >= 3
+    for row in s["sweep"]:
+        assert row["actions_per_sec"] > 0
+        assert row["trace_orphans"] == 0
+        assert row["hierarchy_violations"] == 0
+        for pct in ("p50", "p95", "p99"):
+            assert row["latency_ms"][pct] is not None
+    # the continuous-batching claim, measured on the same wire
+    pair = s["batching"]
+    assert pair["batched_actions_per_sec"] > 0
+    assert pair["unbatched_actions_per_sec"] > 0
+    assert pair["speedup"] is not None and pair["speedup"] > 1.0
+    chaos = s["chaos"]
+    assert chaos["server_kills"] >= 1
+    assert chaos["mttr_s"] and all(m is not None for m in chaos["mttr_s"])
+    assert chaos["torn"]["injected"] >= 1 and chaos["torn"]["accepted"] == 0
+    assert chaos["trace"]["orphans"] == 0
+    assert chaos["hierarchy_violations"] == 0
