@@ -444,6 +444,7 @@ def bench_fleet(ns=(8, 32, 64, 128, 256), duration_s: float = 10.0,
         default_chaos,
         run_learners,
         run_recovery,
+        run_sampler,
         run_serving,
         run_sweep,
         run_weights,
@@ -499,6 +500,16 @@ def bench_fleet(ns=(8, 32, 64, 128, 256), duration_s: float = 10.0,
     artifact["serving"] = run_serving(
         lane_counts=(1, 2, 4), duration_s=min(duration_s, 4.0),
         seed=seed, server_kills=1)
+    # sample-on-ingest block: the dealer-vs-host A/B pair (wire_to_grad
+    # p95 each arm, buffer-lock acquisitions on the consume path — the
+    # dealer arm's pinned 0 by construction) + one dealer chaos row at
+    # N=64 (consumer kills + ring clears, shed pressure, stale-gen frame
+    # injection) gated by 0 deadlocks/violations/orphans/dealt dead
+    # tickets. Schema-checked in tier-1 (tests/test_sampler.py) like the
+    # blocks above.
+    artifact["sampler"] = run_sampler(
+        n_actors=max(64, min(ns)), duration_s=min(duration_s, 6.0),
+        seed=seed, learner_kills=2, stale_frames=8)
     return artifact
 
 

@@ -90,6 +90,13 @@ class ExperimentConfig:
     # both paths drive learner/loop.FusedLoop). N > 1 requires the
     # host-sampled replay path (fused device replay is single-consumer).
     learners: int = 1  # --learners
+    # Sample-on-ingest (docs/architecture.md "Sample-on-ingest"): PER
+    # sampling runs on the receive path — the commit thread deals
+    # ready-to-train blocks into per-replica rings inside its own
+    # buffer-lock window, and replicas feed TD priorities back through a
+    # generation-fenced write-back queue. Requires the host replay path
+    # (--fused_replay off) with prioritized replay.
+    sample_on_ingest: bool = False
     # 'async': clipped importance-weighted staleness correction, no
     # barrier; 'sync': plain N-way averaging barrier per round
     agg_mode: str = "async"
@@ -471,6 +478,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--agg_clip", type=float, default=d.agg_clip,
                    help="staleness-weight clip (async mode): a stale "
                         "update's weight is max(1/(1+lag), 1/clip)")
+    _add_bool_flag(p, "sample_on_ingest", d.sample_on_ingest,
+                   "fuse PER sampling into the receive path: the commit "
+                   "thread deals ready-to-train blocks to the learner "
+                   "replicas (host replay + prioritized only)")
     p.add_argument("--profile_dir", default=d.profile_dir)
     p.add_argument("--log_dir", default=d.log_dir)
     p.add_argument("--seed", type=int, default=d.seed)
@@ -499,4 +510,5 @@ def parse_args(argv=None) -> ExperimentConfig:
     ns["concurrent_eval"] = bool(ns["concurrent_eval"])
     ns["strict_reference"] = bool(ns["strict_reference"])
     ns["normalize_obs"] = bool(ns["normalize_obs"])
+    ns["sample_on_ingest"] = bool(ns["sample_on_ingest"])
     return ExperimentConfig(**ns)

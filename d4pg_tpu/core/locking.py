@@ -77,6 +77,18 @@ HIERARCHY: dict[str, int] = {
     "pserve": 25,   # PolicyInferenceServer._pserve_cond (pending + params)
     "wstore": 24,   # WeightStore._store_lock (published params + version)
     "shard": 20,    # _IngestShard.cond (admission deque + counters)
+    # Sample-on-ingest plane (replay/sampler.py): the dealer's shard-slice
+    # PER trees, write-back queues and counters live under ONE sampler
+    # lock. Between shard and ring: the commit thread reaches it while
+    # holding the buffer lock (insert-priorities + draw + gather in the
+    # commit's existing buffer-lock window — buffer -> sampler descends),
+    # a shard worker draining its write-back queues takes it at top level,
+    # and the dealer pushes dealt blocks into the per-replica rings AFTER
+    # releasing it (sampler -> ring would descend, but the publish happens
+    # lock-free of the sampler tier anyway). Replica write-back enqueue is
+    # sampler-only — the "zero buffer-lock acquisitions on the replica
+    # sample path" invariant of ISSUE 12.
+    "sampler": 15,  # SampleDealer._sampler_lock (slice trees + queues)
     "ring": 10,     # MultiRingStaging._ring_locks[i] (staging ring slices)
 }
 

@@ -169,6 +169,12 @@ class ReplayService:
         # races the learner thread's sample()/update_priorities()
         # otherwise (segment-tree aggregates are multi-word updates).
         self._buffer_lock = TieredLock("buffer")
+        # Sample-on-ingest dealer (replay/sampler.SampleDealer), attached
+        # via attach_dealer. Written under _buffer_lock; the replica-side
+        # readers (queue_writeback) take a benign set-once atomic read —
+        # forcing them through the buffer lock would reintroduce the very
+        # contention the dealer removes.
+        self._dealer = None
         # Batches accepted into a shard but not yet committed; counted on
         # the producer side so flush() can't slip through the window
         # between queue-pop and buffer insert.
@@ -399,6 +405,8 @@ class ReplayService:
                 _tracer.terminal_shed(trace[0])
         if shed_seqs:
             self._tombstone(shed_seqs)
+            if self._dealer is not None:
+                self._dealer.mark_dead_seqs(shed_seqs)
             record_event("shed", shard=s.idx, batches=shed_batches,
                          seqs=shed_seqs[:8])
             for tid in shed_tids:
@@ -477,6 +485,43 @@ class ReplayService:
             with self._buffer_lock:
                 self.buffer.update_priorities(idx, priorities,
                                               generation=generation)
+
+    def attach_dealer(self, dealer) -> None:
+        """Wire a ``replay/sampler.SampleDealer`` into the commit path.
+        From here on every ordered commit mirrors its inserts into the
+        dealer's slice trees and deals ready-to-train blocks into the
+        per-replica rings; replicas feed TD priorities back through
+        :meth:`queue_writeback` (sampler tier only — the replica sample
+        path never acquires the buffer lock again)."""
+        with self._buffer_lock:
+            dealer.resync(self.buffer)
+            self._dealer = dealer
+        # Demand-driven top-up: a replica pop that frees ring room wakes
+        # the commit loop (its idle tick deals the refill) instead of
+        # leaving the refill to the next ingest commit or the ~10 Hz
+        # timeout — a consumer faster than the commit cadence would
+        # otherwise starve on an empty ring. The kick runs on the
+        # replica thread with no locks held (the ring condition is
+        # released before the callback fires), so taking the commit
+        # condition here is a top-level acquire, not an ascent.
+        for ring in dealer.rings:
+            ring.on_room = self._kick_commit
+
+    def _kick_commit(self) -> None:
+        with self._commit_cond:
+            self._commit_cond.notify_all()
+
+    def queue_writeback(self, idx: np.ndarray, priorities: np.ndarray,
+                        generation: np.ndarray) -> None:
+        """Replica-side priority write-back on the dealt path. Enqueues
+        under the ``sampler`` tier; the owning ingest shard's worker (and
+        the commit thread's settle-before-draw) applies it to the slice
+        trees. Generation-fenced exactly like ``update_priorities``."""
+        dealer = self._dealer
+        if dealer is None:
+            raise RuntimeError("queue_writeback requires an attached "
+                               "SampleDealer (attach_dealer)")
+        dealer.queue_writeback(idx, priorities, generation)
 
     def drain_device(self) -> int:
         """Flush ALL rows staged by a fused-path buffer
@@ -576,6 +621,15 @@ class ReplayService:
             self._rows_committed = int(snap.get("rows_committed", 0))
             self._generation = max(self._generation,
                                    int(snap.get("generation", 0)) + 1)
+        dealer = self._dealer
+        if dealer is not None:
+            # drop blocks dealt against the pre-restore state, then
+            # rebuild the slice trees from the restored buffer; pending
+            # write-backs die with the resync (their generations are
+            # fenced by the bump above anyway)
+            dealer.clear_rings()
+            with self._buffer_lock:
+                dealer.resync(self.buffer)
 
     @property
     def generation(self) -> int:
@@ -704,6 +758,12 @@ class ReplayService:
         the shed watermark / blocking-add contract lives, exactly like
         the single drain thread it replaces."""
         while not self._stop.is_set():
+            dealer = self._dealer
+            if dealer is not None:
+                # the owning shard drains ITS slices' priority write-back
+                # queues — top-level sampler-tier acquire, no other lock
+                # held, so the slice trees keep a single writer per slice
+                dealer.drain_writebacks_for_shard(s.idx)
             with self._commit_cond:
                 while self._out[s.idx] and not self._stop.is_set():
                     self._commit_cond.wait(timeout=0.1)
@@ -757,12 +817,15 @@ class ReplayService:
             if dead:
                 record_event("decode_error", shard=s.idx, tickets=dead[:8],
                              n=len(dead))
+                if dealer is not None:
+                    dealer.mark_dead_seqs(dead)
                 for tid in dead_tids:
                     _tracer.terminal_shed(tid)  # tombstoned, not leaked
                 with self._lock:
                     self._pending -= len(dead)
 
-    def _pop_ready(self, group: list, shed_tids: list | None = None) -> int:
+    def _pop_ready(self, group: list, shed_tids: list | None = None,
+                   shed_seqs: list | None = None) -> int:
         """Pop the next run of in-ticket-order items (caller holds
         ``_commit_cond``). Tombstoned tickets are consumed and skipped.
 
@@ -788,6 +851,8 @@ class ReplayService:
                     stale += 1
                     if shed_tids is not None and item[5] is not None:
                         shed_tids.append(item[5])
+                    if shed_seqs is not None:
+                        shed_seqs.append(item[0])
                 if dq and dq[0][0] == self._next_seq:
                     found = dq.popleft()
                     break
@@ -805,13 +870,14 @@ class ReplayService:
         while True:
             group: list = []
             shed_tids: list = []
+            stale_seqs: list = []
             with self._commit_cond:
-                stale = self._pop_ready(group, shed_tids)
+                stale = self._pop_ready(group, shed_tids, stale_seqs)
                 if not group:
                     if self._stop.is_set():
                         return
                     self._commit_cond.wait(timeout=0.1)
-                    stale += self._pop_ready(group, shed_tids)
+                    stale += self._pop_ready(group, shed_tids, stale_seqs)
                 if group or stale:
                     # inbox slots freed: wake gated shard workers
                     self._commit_cond.notify_all()
@@ -832,6 +898,8 @@ class ReplayService:
                              n=stale)
                 for tid in shed_tids:
                     _tracer.terminal_shed(tid)
+                if self._dealer is not None:
+                    self._dealer.mark_dead_seqs(stale_seqs)
                 with self._lock:
                     self._pending -= stale
             if group:
@@ -857,8 +925,19 @@ class ReplayService:
                 if advanced:
                     record_event("order_break", kind_detail="floor_advance")
                 last_progress = time.monotonic()
+            if not group and self._dealer is not None:
+                # idle deal tick: settle write-backs and top the rings
+                # back up even when ingest is quiet — still the commit
+                # thread, still one buffer-lock window per tick
+                dealer = self._dealer
+                with self._buffer_lock:
+                    dealt = dealer.ingest_and_deal((), self.buffer)
+                if dealt:
+                    dealer.publish(dealt)
 
     def _insert_group(self, group: list) -> None:
+        dealer = self._dealer
+        dealt: list = []
         try:
             if self.obs_norm is not None:
                 # Only obs rows feed the estimator; next_obs is
@@ -881,9 +960,21 @@ class ReplayService:
                         next_obs=self.obs_norm.normalize(batch.next_obs),
                     ), rows, cnt, tid)
             with self._buffer_lock:
-                for _seq, _aid, batch, _rows, _cnt, _tid in group:
-                    if batch is not None:  # None: already direct-staged
-                        self.buffer.add(batch)
+                if dealer is None:
+                    for _seq, _aid, batch, _rows, _cnt, _tid in group:
+                        if batch is not None:  # None: already direct-staged
+                            self.buffer.add(batch)
+                else:
+                    # sample-on-ingest: insert, mirror, settle write-backs
+                    # and draw dealt blocks inside the ONE buffer-lock
+                    # window this commit already owned — the collapsed
+                    # ingest->insert->sample->fetch pass
+                    inserts = []
+                    for _seq, _aid, batch, _rows, _cnt, _tid in group:
+                        if batch is not None:
+                            inserts.append(
+                                (self.buffer.add(batch), _seq, _tid))
+                    dealt = dealer.ingest_and_deal(inserts, self.buffer)
         finally:
             committed = 0
             with self._lock:
@@ -901,6 +992,9 @@ class ReplayService:
             REGISTRY.counter("ingest.rows_committed").inc(committed)
             _tracer.mark_committed(
                 [tid for *_rest, tid in group if tid is not None])
+        if dealt:
+            # ring pushes + deal spans AFTER every service lock released
+            dealer.publish(dealt)
 
     def flush(self, timeout: float = 5.0) -> None:
         """Block until every accepted batch has been committed."""
@@ -925,6 +1019,9 @@ class ReplayService:
         call twice (provider unregistration is instance-guarded, thread
         joins are idempotent)."""
         REGISTRY.unregister_provider("ingest", self.ingest_stats)
+        if self._dealer is not None:
+            # closes the dealt rings too, waking any blocked replica pop
+            self._dealer.close()
         self._stop.set()
         for s in self._shards:
             with s.cond:

@@ -368,6 +368,64 @@ def run_learners(
             "sweep": sweep, "chaos": chaos_row, "seed": int(seed)}
 
 
+def run_sampler(
+    n_actors: int = 64,
+    duration_s: float = 6.0,
+    seed: int = 0,
+    learner_kills: int = 2,
+    stale_frames: int = 8,
+    **overrides,
+) -> dict:
+    """The bench_fleet sampler block (``fleet/sampler_chaos.py``):
+
+    - **ab**: a fault-free dealer-vs-host pair under the SAME offered
+      load and seed — wire_to_grad p95 on each arm, buffer-lock
+      acquisitions on the consume path (the dealer arm's must be 0 by
+      construction), blocks/s dealt.
+    - **chaos**: one dealer-mode run at ``n_actors`` with the full
+      fault set — seeded sender chaos, consumer kills + ring clears,
+      shed pressure, stale-generation frame injection — gated by the
+      run oracles (0 deadlocks / violations / orphans / dealt dead
+      tickets).
+    """
+    from d4pg_tpu.fleet.sampler_chaos import (
+        SamplerChaosConfig,
+        run_sampler_chaos,
+    )
+
+    ab = {}
+    for path in ("host", "dealer"):
+        r = run_sampler_chaos(
+            SamplerChaosConfig(
+                sample_path=path, n_actors=int(n_actors),
+                duration_s=float(duration_s), learner_kills=0,
+                stale_frames=0, seed=int(seed), **overrides),
+            chaos=ChaosConfig(seed=int(seed)))
+        ab[path] = {
+            "wire_to_grad_p95_ms": r["wire_to_grad_p95_ms"],
+            "sample_path_buffer_acqs":
+                r["consumer"]["sample_path_buffer_acqs"],
+            "blocks_consumed": r["consumer"]["blocks_consumed"],
+            "rows_inserted": r["rows_inserted"],
+            "deadlocks": r["deadlocks"],
+            "hierarchy_violations": r["hierarchy_violations"],
+            "trace_orphans": r["trace_orphans"],
+            "sampler": r["sampler"],
+        }
+    d, h = (ab["dealer"]["wire_to_grad_p95_ms"],
+            ab["host"]["wire_to_grad_p95_ms"])
+    ab["wire_to_grad_p95_delta_ms"] = (round(d - h, 3)
+                                       if d is not None and h is not None
+                                       else None)
+    chaos_row = run_sampler_chaos(SamplerChaosConfig(
+        sample_path="dealer", n_actors=int(n_actors),
+        duration_s=float(duration_s), learner_kills=int(learner_kills),
+        stale_frames=int(stale_frames), seed=int(seed), **overrides),
+        chaos=default_chaos(int(seed)))
+    return {"metric": "fleet_sampler", "schema": 1, "n_actors": int(n_actors),
+            "ab": ab, "chaos": chaos_row, "seed": int(seed)}
+
+
 def run_serving(
     lane_counts=(1, 2, 4),
     envs_per_lane: int = 4,
@@ -507,6 +565,11 @@ def main(argv=None):
                     help="run the multi-learner block instead: updates/s "
                          "vs these replica counts + one replica-kill "
                          "chaos row (fleet/learner_chaos.py)")
+    ap.add_argument("--sampler", action="store_true",
+                    help="run the sample-on-ingest block instead: a "
+                         "dealer-vs-host A/B pair + one dealer chaos row "
+                         "(consumer kills, shed pressure, stale-gen "
+                         "injection — fleet/sampler_chaos.py)")
     ap.add_argument("--serving", type=int, nargs="+", default=None,
                     metavar="LANES",
                     help="run the serving block instead: actions/s vs "
@@ -521,7 +584,12 @@ def main(argv=None):
     ns = ap.parse_args(argv)
     chaos = (ChaosConfig(seed=ns.seed) if ns.no_chaos
              else default_chaos(ns.seed))
-    if ns.serving:
+    if ns.sampler:
+        artifact = run_sampler(
+            n_actors=max(ns.ns), duration_s=ns.seconds, seed=ns.seed,
+            **({"learner_kills": 0, "stale_frames": 0}
+               if ns.no_chaos else {}))
+    elif ns.serving:
         artifact = run_serving(
             lane_counts=tuple(ns.serving), duration_s=ns.seconds,
             seed=ns.seed,

@@ -26,6 +26,8 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+import numpy as np
+
 from d4pg_tpu.learner.pipeline import IngestOverlap
 from d4pg_tpu.learner.state import D4PGConfig, D4PGState
 from d4pg_tpu.obs.trace import RECORDER as _trace_recorder
@@ -135,3 +137,65 @@ class FusedLoop:
         consumer (a respawned replica) can claim it."""
         if self.ingest is not None:
             self.ingest.release()
+
+
+class DealtLoop:
+    """Drives pre-sampled dealt blocks from a ``DealtBlockRing`` — the
+    consumer half of the sample-on-ingest plane (``replay/sampler.py``).
+
+    Mirrors ``FusedLoop.run``'s contract (state in, ``(state, metrics)``
+    out, ``on_chunk`` callback) so ``LearnerReplica`` treats both
+    pre-sampled paths uniformly. Per block:
+
+        ring.pop()                  # leaf-tier wait — NO buffer lock
+        dispatch K scanned steps    # block rows + dealer IS weights
+        service.queue_writeback()   # TD priorities, gen-fenced, drained
+                                    # by the owning ingest shard
+        trace mark_grad             # deal->grad span terminal
+
+    The grad loop never acquires the buffer lock: sampling already
+    happened on the commit thread, and the write-back only enqueues
+    under the ``sampler`` tier. ``stop`` (an ``Event``) lets the owning
+    replica abandon a blocked pop mid-round on kill.
+    """
+
+    def __init__(self, update_fn, ring, service, *,
+                 stop=None, pop_timeout: float = 0.2):
+        self._update = update_fn
+        self._ring = ring
+        self._service = service
+        self._stop = stop
+        self._pop_timeout = float(pop_timeout)
+        self.steps_done = 0
+        self.blocks = 0
+
+    def run(
+        self,
+        state: D4PGState,
+        n: int,
+        on_chunk: Optional[Callable[[D4PGState, int], None]] = None,
+    ):
+        """At least ``n`` grad steps from dealt blocks (blocks arrive in
+        dealer-sized chunks of K, so the final block may overshoot);
+        returns ``(state, metrics)`` with the LAST block's stacked-[k]
+        metrics (``None`` when nothing was consumed — closed ring)."""
+        metrics = None
+        done = 0
+        while done < n and (self._stop is None or not self._stop.is_set()):
+            block = self._ring.pop(timeout=self._pop_timeout)
+            if block is None:
+                if self._ring.closed:
+                    break
+                continue
+            state, metrics = self._update(
+                state, block.batches, block.weights)
+            td = np.abs(np.asarray(metrics["td_error"])) + 1e-6
+            self._service.queue_writeback(block.idx, td, block.gen)
+            _trace_recorder.mark_grad()
+            k = int(block.idx.shape[0])
+            done += k
+            self.steps_done += k
+            self.blocks += 1
+            if on_chunk is not None:
+                on_chunk(state, k)
+        return state, metrics

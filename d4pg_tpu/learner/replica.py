@@ -31,6 +31,20 @@ Two sampling modes, chosen by what the replica is given:
   the service's own buffer lock (thread-safe for N concurrent
   replicas) + ``make_multi_update`` K-scanned dispatch + deferred PER
   priority write-back with the generation guard.
+- **dealt** (``dealt_ring`` passed with ``service``): the
+  sample-on-ingest plane (``replay/sampler.py``) — the replica pops
+  ready-to-train blocks (rows + IS weights, pre-sampled by the commit
+  thread's dealer) from its bounded ring and feeds TD priorities back
+  through ``service.queue_writeback``. The sample path acquires the
+  ring leaf lock and the ``sampler`` tier ONLY — never the buffer
+  lock, which is the whole point.
+
+PER beta annealing: with N replicas each replica annealing off its own
+``steps_done`` would scale the anneal rate with N (the PR-10 defect) —
+pass one shared ``replay/schedule.SharedBetaSchedule`` as
+``beta_schedule`` and every replica reads the same global clock. When
+omitted, a private schedule reproduces the legacy single-replica
+behavior bitwise.
 
 Locking: ``_replica_lock`` (tier ``replica`` = 36) guards ONLY control
 state — counters, epoch, stop flag. It is never held across sampling,
@@ -90,6 +104,8 @@ class LearnerReplica:
         beta_steps: int = 100_000,
         buffer=None,
         service=None,
+        dealt_ring=None,
+        beta_schedule=None,
         mesh=None,
         donate: bool = True,
     ):
@@ -97,18 +113,36 @@ class LearnerReplica:
             raise ValueError(
                 "need buffer= (fused mode, sole consumer; service= "
                 "optionally adds the ingest overlap) or service= alone "
-                "(host-sampled mode, N-replica safe)")
+                "(host-sampled mode, N-replica safe; add dealt_ring= "
+                "for the sample-on-ingest dealt mode)")
+        if dealt_ring is not None and (buffer is not None or service is None):
+            raise ValueError("dealt mode needs service= (for the priority "
+                             "write-back) and no fused buffer=")
+        if dealt_ring is not None and not prioritized:
+            raise ValueError(
+                "dealt mode is PER-only: dealt blocks carry IS weights")
         self.replica_id = int(replica_id)
         self._config = config
         self._agg = agg
         self._state = state
-        self.mode = "fused" if buffer is not None else "host"
+        if buffer is not None:
+            self.mode = "fused"
+        elif dealt_ring is not None:
+            self.mode = "dealt"
+        else:
+            self.mode = "host"
         self.k = max(1, int(k))
         self._batch_size = int(batch_size)
         self._prioritized = bool(prioritized)
         self._beta0 = float(beta0)
         self._beta_steps = int(beta_steps)
         self._service = service
+        self._dealt_ring = dealt_ring
+        # shared anneal clock (see module doc); private fallback keeps
+        # the legacy single-replica anneal bitwise
+        from d4pg_tpu.replay.schedule import SharedBetaSchedule
+        self._beta_sched = beta_schedule or SharedBetaSchedule(
+            beta0=self._beta0, beta_steps=self._beta_steps)
         self._loop = None
         self._update = None
         if self.mode == "fused":
@@ -124,6 +158,11 @@ class LearnerReplica:
         # control state ONLY under this lock (see module doc)
         self._replica_lock = TieredLock("replica")
         self._stop = threading.Event()
+        self._dealt_loop = None
+        if self.mode == "dealt":
+            from d4pg_tpu.learner.loop import DealtLoop
+            self._dealt_loop = DealtLoop(
+                self._update, dealt_ring, service, stop=self._stop)
         self.epoch = agg.register(self.replica_id,
                                   params=params_of(state), step=0)
         self.steps_done = 0
@@ -135,18 +174,19 @@ class LearnerReplica:
         self.last_status = "idle"
 
     # -- sampling/update paths ----------------------------------------------
-    def _beta(self) -> float:
-        t = min(1.0, self.steps_done / max(1, self._beta_steps))
-        return self._beta0 + (1.0 - self._beta0) * t
-
     def _host_steps(self, n: int) -> None:
         svc = self._service
         done = 0
+        # ONE clock read for the whole call: beta is constant across the
+        # call's chunks (the legacy per-chunk ``_beta()`` was too, since
+        # ``steps_done`` only advanced after the loop) and two replicas
+        # at the same global step compute the identical value.
+        beta = self._beta_sched.beta_at(self._beta_sched.current_step())
         while done < n and not self._stop.is_set():
             k = min(self.k, n - done)
             if self._prioritized:
                 batches, w, idx, gen = svc.sample_chunk(
-                    k, self._batch_size, beta=self._beta(),
+                    k, self._batch_size, beta=beta,
                     weight_base=svc.weight_base())
                 self._state, metrics = self._update(self._state, batches, w)
                 td = np.abs(np.asarray(metrics["td_error"])) + 1e-6
@@ -157,7 +197,22 @@ class LearnerReplica:
                 self._state, metrics = self._update(self._state, batches)
             self.last_metrics = metrics
             done += k
+        if done:
+            self._beta_sched.advance(done)
         self.steps_done += done
+
+    def _dealt_steps(self, n: int) -> None:
+        """Consume pre-sampled blocks through the extracted ``DealtLoop``
+        (``learner/loop.py``): pop, K-chunk update, queue the TD
+        write-back. No buffer-lock acquisition anywhere on this path —
+        the ring pop is a leaf-tier wait and the write-back enqueues
+        under the ``sampler`` tier (beta already rode in with the block,
+        annealed by the dealer's shared clock)."""
+        before = self._dealt_loop.steps_done
+        self._state, metrics = self._dealt_loop.run(self._state, n)
+        if metrics is not None:
+            self.last_metrics = metrics
+        self.steps_done += self._dealt_loop.steps_done - before
 
     def _fused_steps(self, n: int) -> None:
         self._state, metrics = self._loop.run(self._state, n)
@@ -175,6 +230,8 @@ class LearnerReplica:
             self._state = adopt_params(self._state, basis)
         if self.mode == "fused":
             self._fused_steps(n)
+        elif self.mode == "dealt":
+            self._dealt_steps(n)
         else:
             self._host_steps(n)
         result = self._agg.submit(

@@ -98,6 +98,7 @@ _DEFAULT_TIERS = {
     "cond": "shard",
     "shard_lock": "shard",
     "_shard_locks": "shard",
+    "_sampler_lock": "sampler",
     "_ring_locks": "ring",
     "ring_lock": "ring",
     "_leaf_lock": "ring",
@@ -109,7 +110,7 @@ _DEFAULT_TIERS = {
 # tables equal, so they cannot drift.
 _TIER_VALUES = {"service": 50, "buffer": 40, "replica": 36, "agg": 34,
                 "commit": 30, "wrelay": 28, "wserve": 26, "pserve": 25,
-                "wstore": 24, "shard": 20, "ring": 10}
+                "wstore": 24, "shard": 20, "sampler": 15, "ring": 10}
 
 
 def _tier_values() -> dict[str, int]:

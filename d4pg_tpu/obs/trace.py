@@ -63,8 +63,14 @@ from d4pg_tpu.obs.registry import percentile_summary
 # margin.
 DEFAULT_SAMPLE = 0.02
 
-# Pipeline stages in order; `shed` is the failure terminal.
-STAGES = ("send", "admission", "decode", "stage", "merge", "commit", "grad")
+# Pipeline stages in order; `shed` is the failure terminal. ``deal`` is
+# the sample-on-ingest plane's post-commit stage: the dealer stamps the
+# NEWEST constituent frame of each dealt block, so a block's deal span is
+# a child of a committed trace. Terminals are unchanged — commit already
+# terminates a trace, so a dealt block lost to a learner kill can never
+# orphan the accounting.
+STAGES = ("send", "admission", "decode", "stage", "merge", "commit", "deal",
+          "grad")
 TERMINALS = ("commit", "grad", "shed")
 
 # Stage pairs the latency block reports (label, from, to).
@@ -74,6 +80,8 @@ _PAIRS = (
     ("decode_to_stage", "decode", "stage"),
     ("stage_to_merge", "stage", "merge"),
     ("merge_to_commit", "merge", "commit"),
+    ("commit_to_deal", "commit", "deal"),
+    ("deal_to_grad", "deal", "grad"),
     ("commit_to_grad", "commit", "grad"),
     ("wire_to_commit", "send", "commit"),
     ("wire_to_grad", "send", "grad"),
@@ -228,7 +236,12 @@ class TraceRecorder:
             elif "commit" in spans:
                 completed += 1
             for label, a, b in _PAIRS:
-                if a in spans and b in spans:
+                # b >= a: pipeline pairs are naturally ordered, except
+                # deal/grad — a frame's first grad-after-commit can
+                # predate a later RE-deal of the same slot, in which
+                # case the deal span did not feed that grad and the
+                # pair is causally mispaired, not a negative latency
+                if a in spans and b in spans and spans[b] >= spans[a]:
                     stages[label].append(1e3 * (spans[b] - spans[a]))
         with self._mu:
             rate, overflow = self.sample_rate, self.overflow

@@ -110,9 +110,13 @@ class NativePerTrees:
             lib.pt_free(h)
 
     def set(self, idx: np.ndarray, values: np.ndarray) -> None:
-        idx = np.ascontiguousarray(idx, np.int64)
-        values = np.ascontiguousarray(values, np.float64)
-        self._lib.pt_set(self._h, _i64(idx), _f64(values), len(idx))
+        # ravel: callers pass [K, B] chunk indices (update_priorities);
+        # the C ABI takes flat arrays and an ELEMENT count — len() of a
+        # 2D array is its outer dim and would silently drop K*(B-1)
+        # writes. Flattened order keeps numpy's last-wins on duplicates.
+        idx = np.ascontiguousarray(np.asarray(idx, np.int64).ravel())
+        values = np.ascontiguousarray(np.asarray(values, np.float64).ravel())
+        self._lib.pt_set(self._h, _i64(idx), _f64(values), idx.size)
 
     def sum(self) -> float:
         return float(self._lib.pt_total(self._h))
@@ -121,13 +125,15 @@ class NativePerTrees:
         return float(self._lib.pt_min(self._h))
 
     def get(self, idx: np.ndarray) -> np.ndarray:
-        idx = np.ascontiguousarray(idx, np.int64)
-        out = np.empty(len(idx), np.float64)
-        self._lib.pt_get(self._h, _i64(idx), _f64(out), len(idx))
-        return out
+        idx = np.asarray(idx, np.int64)
+        flat = np.ascontiguousarray(idx.ravel())
+        out = np.empty(flat.size, np.float64)
+        self._lib.pt_get(self._h, _i64(flat), _f64(out), flat.size)
+        return out.reshape(idx.shape)  # shape parity with the numpy trees
 
     def find_prefixsum(self, prefix: np.ndarray) -> np.ndarray:
-        prefix = np.ascontiguousarray(prefix, np.float64)
-        out = np.empty(len(prefix), np.int64)
-        self._lib.pt_find_prefix(self._h, _f64(prefix), _i64(out), len(prefix))
-        return out
+        prefix = np.asarray(prefix, np.float64)
+        flat = np.ascontiguousarray(prefix.ravel())
+        out = np.empty(flat.size, np.int64)
+        self._lib.pt_find_prefix(self._h, _f64(flat), _i64(out), flat.size)
+        return out.reshape(prefix.shape)

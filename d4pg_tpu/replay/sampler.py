@@ -1,0 +1,545 @@
+"""Sample-on-ingest: PER sampling fused into the sharded receive path.
+
+The host-side latency between a wire frame and a grad step is the
+ingest -> insert -> sample -> fetch round trip: the commit thread inserts
+rows under the buffer lock, a learner replica later re-acquires the same
+lock to walk the sum tree, gather rows and compute IS weights, and under
+N replicas those walks contend (the PR-10 host-sample path). This module
+collapses the round trip into one pipelined pass, the way "In-Network
+Experience Sampling" (PAPERS.md, arXiv 2110.13506) rides sampling on the
+transport and "Accelerated Methods for Deep RL" (arXiv 1803.02811) deals
+whole sampled blocks rather than rows:
+
+  - :class:`ShardSlicePerTrees` keeps the PER sum/min tree as S
+    contiguous per-shard slices plus a tiny top tree over the slice
+    roots. Same pairwise reduction structure as one flat
+    ``segment_tree.SumTree`` over the full capacity, so totals, mins and
+    the inverse-CDF descent are BITWISE identical to the single tree —
+    the merge is structural, not a cumsum (float addition is not
+    associative; re-bracketing would break the bitwise oracle).
+  - :class:`SampleDealer` is driven by the commit thread — the owner of
+    global ticket order. Inside the commit's existing buffer-lock window
+    it mirrors each insert into the slice trees, settles the priority
+    write-back queues, and deals ready-to-train blocks (rows + IS
+    weights + indices + generations) drawn from its own seeded stream —
+    bitwise the same stream a host ``sample_chunk`` loop would draw.
+    Blocks are published into bounded per-replica rings
+    (``staging.DealtBlockRing``) AFTER every lock is released.
+  - Priority write-back from grad steps is a generation-fenced queue:
+    replicas enqueue under the ``sampler`` tier only (ZERO buffer-lock
+    acquisitions on the replica sample path); the owning ingest shard's
+    worker drains its slices' queues, so every tree write still has a
+    single writer under the tier discipline (``core.locking``:
+    buffer > shard > sampler > ring).
+
+Determinism contract (the tier-1 bitwise oracle): with the same seed,
+the same insert order and the same write-back order, the dealer's blocks
+(indices, weights, dtypes) equal the legacy host path —
+``buffer.add`` + ``update_priorities`` + ``sample_chunk`` — exactly.
+Draws that cannot be dealt (ring full, paused, warmup) are SKIPPED
+before touching the RNG, so backpressure never desynchronizes the
+stream.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import NamedTuple
+
+import numpy as np
+
+from d4pg_tpu.core.locking import TieredLock
+from d4pg_tpu.obs import trace as obs_trace
+from d4pg_tpu.obs.registry import REGISTRY
+from d4pg_tpu.replay.schedule import SharedBetaSchedule
+from d4pg_tpu.replay.segment_tree import next_pow2
+
+# Write-back fencing keeps a bounded memory of dead (shed / tombstoned /
+# generation-fenced) ticket seqs for the audit cross-check; past the
+# bound the oldest are forgotten — the invariant itself is structural
+# (dead tickets never insert rows), the audit only witnesses it.
+_DEAD_SEQ_BOUND = 4096
+
+
+class ShardSlicePerTrees:
+    """PER sum+min trees partitioned into per-shard slices of the slot
+    space, merged by a top tree over the slice roots.
+
+    Slot space ``[0, capacity)`` (capacity rounded to a power of two) is
+    split into ``n_slices`` (rounded likewise, clamped to capacity)
+    contiguous slices of ``slice_cap`` leaves; slice ``j`` covers slots
+    ``[j * slice_cap, (j+1) * slice_cap)``, so the ring-order inserts a
+    given ingest shard commits land in a dense run of its own slice —
+    the single-writer unit the write-back drain is organized around.
+
+    Every aggregate is the same pairwise reduction a single
+    ``segment_tree.SumTree`` over the full capacity computes: a slice
+    tree's internal nodes ARE that tree's nodes below the slice-root
+    level, and the top tree's internal nodes ARE its nodes above. Since
+    the operand values and the reduction bracketing are identical,
+    ``total``/``min``/``find_prefixsum`` are bitwise-equal to the single
+    tree (pinned by the tier-1 merge property test across K slices,
+    including all-zero-priority slices).
+
+    That bitwise identity is also a license to delegate: when the native
+    C++ trees are loadable, ``backend='auto'`` backs the whole structure
+    with one flat ``NativePerTrees`` — legal because slice == flat is
+    pinned by the merge property test (``backend='numpy'``) and flat ==
+    native by ``tests/test_native.py``, so every observable value is the
+    same by transitivity. It matters on the hot path: the dealer draws
+    INSIDE the commit thread's buffer-lock window, and the numpy slice
+    walk costs ~6-20x the native calls per deal (measured ~0.45 ms vs
+    ~0.07 ms a block), which is the difference between the dealer
+    stretching every commit and disappearing into it. The slice
+    partition itself (``slice_of``-by-range, the write-back drain
+    ownership) is index arithmetic and works over either backing.
+    """
+
+    def __init__(self, capacity: int, n_slices: int,
+                 backend: str = "auto"):
+        self.capacity = next_pow2(int(capacity))
+        self.n_slices = min(next_pow2(max(1, int(n_slices))), self.capacity)
+        self.slice_cap = self.capacity // self.n_slices
+        self._top_levels = int(np.log2(self.n_slices))
+        self._slice_levels = int(np.log2(self.slice_cap))
+        self._stride = 2 * self.slice_cap
+        if backend not in ("auto", "numpy"):
+            raise ValueError(f"unknown ShardSlicePerTrees backend "
+                             f"{backend!r} (want 'auto' or 'numpy')")
+        self._native_cls = None
+        if backend == "auto":
+            try:
+                from d4pg_tpu.replay.native import NativePerTrees, load_native
+                if load_native() is not None:
+                    self._native_cls = NativePerTrees
+            except Exception:  # pragma: no cover - loader failure = fallback
+                self._native_cls = None
+        self._native = None
+        self.reset()
+
+    def reset(self) -> None:
+        if self._native_cls is not None:
+            # a fresh native tree IS the empty state (sum leaves 0, min
+            # leaves +inf) — pt_new is cheaper than writing every leaf
+            self._native = self._native_cls(self.capacity)
+            return
+        s = self.n_slices
+        self._sum = np.zeros((s, self._stride), np.float64)
+        self._min = np.full((s, self._stride), np.inf, np.float64)
+        self._top = np.zeros(2 * s, np.float64)
+        self._top_min = np.full(2 * s, np.inf, np.float64)
+
+    def set(self, idx: np.ndarray, values: np.ndarray) -> None:
+        """Batched leaf assignment + ancestor repair, the `_Tree.set`
+        scheme per slice plus a top-tree lift for the touched slices."""
+        if self._native is not None:
+            self._native.set(idx, values)
+            return
+        idx = np.asarray(idx, np.int64).ravel()
+        values = np.asarray(values, np.float64).ravel()
+        sl = idx // self.slice_cap
+        node = (idx % self.slice_cap) + self.slice_cap
+        self._sum[sl, node] = values
+        self._min[sl, node] = values
+        # unique (slice, parent) pairs as combined keys: all leaves sit
+        # at the same depth, so parents stay level-aligned across slices
+        # and one halving per iteration repairs one level everywhere
+        comb = np.unique(sl * self._stride + (node >> 1))
+        while True:
+            sp, p = comb // self._stride, comb % self._stride
+            if p[0] < 1:
+                break
+            left = p << 1
+            self._sum[sp, p] = np.add(self._sum[sp, left],
+                                      self._sum[sp, left | 1])
+            self._min[sp, p] = np.minimum(self._min[sp, left],
+                                          self._min[sp, left | 1])
+            if p[0] == 1:
+                break
+            comb = np.unique(sp * self._stride + (p >> 1))
+        touched = np.unique(sl)
+        self._top[self.n_slices + touched] = self._sum[touched, 1]
+        self._top_min[self.n_slices + touched] = self._min[touched, 1]
+        parent = np.unique((self.n_slices + touched) >> 1)
+        while parent[0] >= 1:
+            left = parent << 1
+            self._top[parent] = np.add(self._top[left], self._top[left | 1])
+            self._top_min[parent] = np.minimum(self._top_min[left],
+                                               self._top_min[left | 1])
+            parent = np.unique(parent >> 1)
+            if parent[0] == 0:
+                break
+
+    def get(self, idx: np.ndarray) -> np.ndarray:
+        if self._native is not None:
+            return self._native.get(np.asarray(idx, np.int64))
+        idx = np.asarray(idx, np.int64)
+        return self._sum[idx // self.slice_cap,
+                         (idx % self.slice_cap) + self.slice_cap]
+
+    def total(self) -> float:
+        if self._native is not None:
+            return self._native.sum()
+        return float(self._top[1])
+
+    def min(self) -> float:
+        if self._native is not None:
+            return self._native.min()
+        return float(self._top_min[1])
+
+    def slice_totals(self) -> np.ndarray:
+        """Per-slice priority mass — diagnostic only (under the native
+        backing this is a leaf gather + float sum, not the tree's exact
+        bracketing)."""
+        if self._native is not None:
+            leaves = self._native.get(np.arange(self.capacity))
+            return leaves.reshape(self.n_slices, -1).sum(axis=1)
+        return self._sum[:, 1].copy()
+
+    def find_prefixsum(self, prefix: np.ndarray) -> np.ndarray:
+        """Batched inverse-CDF, two-phase lock-step descent: log2(S)
+        steps through the top tree pick the slice, log2(slice_cap) steps
+        through the slice trees (fancy-indexed across the batch) pick
+        the leaf. Each step is the exact compare-subtract of
+        ``SumTree.find_prefixsum`` over the exact same node values, so
+        the returned slots match the single tree bitwise."""
+        if self._native is not None:
+            return self._native.find_prefixsum(prefix)
+        p = np.asarray(prefix, np.float64).copy()
+        node = np.ones_like(p, dtype=np.int64)
+        for _ in range(self._top_levels):
+            left = node << 1
+            left_sum = self._top[left]
+            go_right = p >= left_sum
+            p = np.where(go_right, p - left_sum, p)
+            node = np.where(go_right, left | 1, left)
+        sl = node - self.n_slices
+        node = np.ones_like(p, dtype=np.int64)
+        for _ in range(self._slice_levels):
+            left = node << 1
+            left_sum = self._sum[sl, left]
+            go_right = p >= left_sum
+            p = np.where(go_right, p - left_sum, p)
+            node = np.where(go_right, left | 1, left)
+        return sl * self.slice_cap + (node - self.slice_cap)
+
+
+class DealtBlock(NamedTuple):
+    """One ready-to-train unit: K stacked proportional samples with their
+    IS weights, slot indices and sample-time generations (the write-back
+    fence), plus the anneal step/beta they were weighted at and the trace
+    id of the newest constituent frame (the ``deal`` span parent)."""
+
+    batches: object  # TransitionBatch, arrays [K, B, ...]
+    weights: np.ndarray  # [K, B] float32
+    idx: np.ndarray  # [K, B] int64
+    gen: np.ndarray  # [K, B] int64
+    beta: float
+    step: int
+    tid: int  # 0 when no constituent frame was traced
+    deal_seq: int
+
+
+class SampleDealer:
+    """The commit thread's sampled-block dealer.
+
+    Single-writer discipline: the slice trees, the generation mirror,
+    ``max_priority``, the RNG and the write-back queues all live under
+    ONE ``sampler``-tier lock. Writers are the commit thread (insert
+    mirror + settle + draw, reached while it already holds the buffer
+    lock — a legal buffer(40) -> sampler(15) descent) and the shard
+    workers draining their own slices' write-back queues (top-level
+    acquire). Replicas only ever ENQUEUE write-backs — sampler tier
+    only, which is what makes the replica sample path buffer-lock-free.
+
+    ``ingest_and_deal`` must be called with the buffer lock held (it
+    reads ``buffer.size`` and gathers rows); ``publish`` must be called
+    after the buffer lock is released (it takes ring locks and stamps
+    the ``deal`` trace span; never while holding the sampler tier, so no
+    sampler -> ring edge exists at all).
+    """
+
+    def __init__(self, capacity: int, rings, *, n_shards: int, k: int,
+                 batch_size: int, alpha: float = 0.6,
+                 beta_schedule: SharedBetaSchedule | None = None,
+                 min_size: int = 1, seed: int = 0, ring_capacity: int = 4,
+                 max_deals_per_tick: int = 1, audit: bool = False):
+        self._sampler_lock = TieredLock("sampler")
+        self._trees = ShardSlicePerTrees(capacity, n_shards)
+        self._n_shards = max(1, int(n_shards))
+        self._rings = list(rings)
+        self.k = int(k)
+        self.batch_size = int(batch_size)
+        self.alpha = float(alpha)
+        self.min_size = max(1, int(min_size))
+        self.ring_capacity = int(ring_capacity)
+        # Deal budget per tick, per ring. The dealer runs INSIDE the
+        # commit thread's buffer-lock window, so refilling a whole
+        # ring's room in one tick (capacity x ~0.5 ms/draw) stalls the
+        # ordered merge behind a multi-ms deal burst — measured as a
+        # ~5 ms p50 bump on every commit-side stage at N=64. One block
+        # per tick keeps the critical-section extension bounded by a
+        # single draw; the ring's depth is the slack that absorbs the
+        # commit/consume cadence mismatch instead.
+        self.max_deals_per_tick = max(1, int(max_deals_per_tick))
+        self._beta = beta_schedule or SharedBetaSchedule()
+        # Same default_rng construction as ReplayBuffer: seed the dealer
+        # with the buffer's seed and its draws replay the exact stream a
+        # host sample_chunk loop over that buffer would consume.
+        self._rng = np.random.default_rng(seed)
+        cap = self._trees.capacity
+        self.max_priority = 1.0
+        self._size = 0
+        self._gen = np.zeros(cap, np.int64)  # jaxlint: guarded-by=_sampler_lock
+        self._src_seq = np.full(cap, -1, np.int64)
+        self._tid_of = np.zeros(cap, np.uint64)  # trace ids are u64 on the wire
+        self._ins_seq = np.zeros(cap, np.int64)
+        self._ins_counter = 0
+        self._wb = [deque() for _ in range(self._trees.n_slices)]
+        self._wb_depth = 0
+        self._wb_lag = REGISTRY.histogram("sampler.writeback_lag_ms")
+        self._paused = False
+        self._audit = bool(audit)
+        self._dead: set = set()
+        self._dead_fifo: deque = deque()
+        self._deal_seq = 0
+        self.dealt_blocks = 0
+        self.dealt_rows = 0
+        self.deals_skipped_full = 0
+        self.deals_dropped = 0
+        self.writeback_dropped_stale = 0
+        self.dealt_dead_tickets = 0
+        self.deal_busy_s = 0.0
+        REGISTRY.register_provider("sampler", self.sampler_stats)
+
+    @property
+    def rings(self):
+        """The per-replica dealt rings, replica-indexed (read-only view —
+        ``ReplayService.attach_dealer`` wires their demand kicks)."""
+        return tuple(self._rings)
+
+    # -- commit-thread side (buffer lock held) ------------------------------
+    def ingest_and_deal(self, inserts, buffer) -> list:
+        """Mirror a commit's inserts, settle pending write-backs, then
+        deal up to ``max_deals_per_tick`` blocks into every ring with
+        room. Caller (the commit
+        thread) HOLDS the buffer lock; rows are gathered here so the
+        whole insert+sample+fetch pass costs the one lock window the
+        commit already owned. Returns ``[(ring_index, DealtBlock)]`` for
+        :meth:`publish` once the buffer lock is released. An empty
+        ``inserts`` list is the idle top-up tick."""
+        t0 = time.monotonic()
+        dealt: list = []
+        with self._sampler_lock:
+            for idx, seq, tid in inserts:
+                idx = np.asarray(idx, np.int64)
+                self._gen[idx] += 1
+                self._src_seq[idx] = -1 if seq is None else int(seq)
+                self._tid_of[idx] = 0 if tid is None else int(tid)
+                self._ins_counter += 1
+                self._ins_seq[idx] = self._ins_counter
+                p = self.max_priority ** self.alpha
+                self._trees.set(idx, np.full(len(idx), p))
+            self._size = int(buffer.size)
+            # settle-then-draw inside one critical section: every draw
+            # sees all write-backs queued before this tick, mirroring the
+            # legacy update_priorities -> sample_chunk order
+            self._settle_locked()
+            if not self._paused and self._size >= self.min_size:
+                for ri, ring in enumerate(self._rings):
+                    room = ring.room()
+                    if room == 0:
+                        # skipped BEFORE any RNG use: backpressure must
+                        # not desynchronize the sample stream (idle
+                        # top-up ticks skip silently — only a commit
+                        # that found no room is a missed deal)
+                        if inserts:
+                            self.deals_skipped_full += 1
+                        continue
+                    for _ in range(min(room, self.max_deals_per_tick)):
+                        blk = self._draw_block_locked(buffer)
+                        if blk is None:
+                            break
+                        dealt.append((ri, blk))
+            self.deal_busy_s += time.monotonic() - t0
+        return dealt
+
+    def publish(self, dealt) -> None:
+        """Push dealt blocks into their rings and stamp each block's
+        ``deal`` span on its newest constituent frame's trace. Called
+        with NO locks held; ring pushes cannot fail for capacity (room
+        was reserved under the sampler lock and only this thread
+        pushes), only for a concurrently closed ring."""
+        for ri, blk in dealt:
+            if blk.tid:
+                obs_trace.RECORDER.record_span(blk.tid, "deal")
+            if not self._rings[ri].offer(blk):
+                with self._sampler_lock:
+                    self.deals_dropped += 1
+
+    def _draw_block_locked(self, buffer):
+        """One K-chunk draw, bitwise the legacy host path:
+        ``weight_base`` + ``sample_chunk`` over the merged trees."""
+        total = self._trees.total()
+        if total <= 0.0:
+            return None
+        size = self._size
+        z = self._trees.min() / total * size  # PrioritizedReplayBuffer.weight_base
+        t = self._beta.current_step()
+        beta = self._beta.beta_at(t)
+        idx = np.stack([self._sample_idx_locked(size) for _ in range(self.k)])
+        max_weight = z ** (-beta)
+        w = []
+        for i in range(self.k):
+            p = self._trees.get(idx[i]) / total
+            w.append(((p * size) ** (-beta) / max_weight).astype(np.float32))
+        gen = self._gen[idx].copy()
+        if self._audit and self._dead:
+            hits = {int(s) for s in self._src_seq[idx.ravel()]} & self._dead
+            self.dealt_dead_tickets += len(hits)
+        flat = idx.ravel()
+        tid = int(self._tid_of[flat[int(np.argmax(self._ins_seq[flat]))]])
+        self._beta.advance(self.k)
+        self._deal_seq += 1
+        self.dealt_blocks += 1
+        self.dealt_rows += self.k * self.batch_size
+        return DealtBlock(buffer.gather(idx), np.stack(w), idx, gen,
+                          beta, t, tid, self._deal_seq)
+
+    def _sample_idx_locked(self, size: int) -> np.ndarray:
+        # PrioritizedReplayBuffer.sample_idx, stratified scheme, verbatim
+        total = self._trees.total()
+        bounds = np.linspace(0.0, total, self.batch_size + 1)
+        mass = self._rng.uniform(bounds[:-1], bounds[1:])
+        idx = self._trees.find_prefixsum(mass)
+        return np.minimum(idx, max(size - 1, 0))
+
+    # -- replica side (sampler tier ONLY — never the buffer lock) -----------
+    def queue_writeback(self, idx: np.ndarray, priorities: np.ndarray,
+                        generation: np.ndarray) -> None:
+        """Enqueue a grad step's TD priorities for the owning shards to
+        apply. Generation-fenced at settle time; raw priorities travel,
+        ``** alpha`` happens at the single writer."""
+        idx = np.asarray(idx, np.int64).ravel()
+        pri = np.asarray(priorities, np.float64).ravel()
+        assert (pri > 0).all(), "priorities must be positive"
+        gen = np.asarray(generation, np.int64).ravel()
+        now = time.monotonic()
+        sl = idx // self._trees.slice_cap
+        with self._sampler_lock:
+            for j in np.unique(sl):
+                m = sl == j
+                self._wb[j].append((idx[m], pri[m], gen[m], now))
+                self._wb_depth += 1
+
+    # -- shard-worker side --------------------------------------------------
+    def drain_writebacks_for_shard(self, shard_idx: int) -> None:
+        """Settle the write-back queues of the slices shard ``shard_idx``
+        owns (slice j belongs to shard j mod n_shards). Called by the
+        shard's worker thread at top level — the sum-tree write stays
+        with its owner. Near-free when idle (unlocked depth probe,
+        benign race under the GIL)."""
+        if self._wb_depth == 0:
+            return
+        with self._sampler_lock:
+            self._settle_locked(owner=int(shard_idx) % self._n_shards)
+
+    def _settle_locked(self, owner: int | None = None) -> None:
+        for j, q in enumerate(self._wb):
+            if owner is not None and j % self._n_shards != owner:
+                continue
+            while q:
+                idx, pri, gen, t_enq = q.popleft()
+                self._wb_depth -= 1
+                self._wb_lag.observe(1e3 * (time.monotonic() - t_enq))
+                live = self._gen[idx] == gen
+                if not live.all():
+                    self.writeback_dropped_stale += int((~live).sum())
+                    idx, pri = idx[live], pri[live]
+                if len(idx) == 0:
+                    continue
+                # PrioritizedReplayBuffer.update_priorities, verbatim
+                self._trees.set(idx, pri ** self.alpha)
+                self.max_priority = max(self.max_priority, float(pri.max()))
+
+    # -- lifecycle ----------------------------------------------------------
+    def mark_dead_seqs(self, seqs) -> None:
+        """Record shed/tombstoned/fenced ticket seqs for the audit
+        cross-check (chaos pins ``dealt_dead_tickets == 0``)."""
+        if not self._audit:
+            return
+        with self._sampler_lock:
+            for s in seqs:
+                s = int(s)
+                if s in self._dead:
+                    continue
+                self._dead.add(s)
+                self._dead_fifo.append(s)
+                while len(self._dead_fifo) > _DEAD_SEQ_BOUND:
+                    self._dead.discard(self._dead_fifo.popleft())
+
+    def clear_rings(self) -> int:
+        """Drop every queued block (restore: blocks dealt against the
+        pre-restore generation must not train). Ring locks only — never
+        called under the sampler tier."""
+        return sum(r.clear() for r in self._rings)
+
+    def pause_dealing(self) -> None:
+        """Stop drawing (inserts and settles continue). With no draws
+        there is no RNG use, so pause/resume is how the bitwise oracle
+        runs the dealer in lockstep with its legacy twin."""
+        with self._sampler_lock:
+            self._paused = True
+
+    def resume_dealing(self) -> None:
+        with self._sampler_lock:
+            self._paused = False
+
+    def resync(self, buffer) -> None:
+        """Re-derive the dealer's PER state from the buffer (attach /
+        checkpoint restore). Caller holds the buffer lock. Pending
+        write-backs are dropped — their generations are fenced by the
+        restore's generation bump anyway."""
+        with self._sampler_lock:
+            self._trees.reset()
+            self._size = int(buffer.size)
+            self.max_priority = float(buffer.max_priority)
+            self._gen = np.asarray(buffer.generation).copy()
+            self._src_seq.fill(-1)
+            self._tid_of.fill(0)
+            self._ins_seq.fill(0)
+            if self._size:
+                live = np.arange(self._size)
+                # leaves already hold priority ** alpha (state_dict note)
+                self._trees.set(live, np.asarray(buffer._trees.get(live)))
+            for q in self._wb:
+                q.clear()
+            self._wb_depth = 0
+
+    def sampler_stats(self) -> dict:
+        """Registry provider: the ``sampler`` block."""
+        with self._sampler_lock:
+            d = {
+                "dealt_blocks": self.dealt_blocks,
+                "dealt_rows": self.dealt_rows,
+                "dealer_queue_depth": self._wb_depth,
+                "deals_skipped_full": self.deals_skipped_full,
+                "deals_dropped": self.deals_dropped,
+                "writeback_dropped_stale": self.writeback_dropped_stale,
+                "dealt_dead_tickets": self.dealt_dead_tickets,
+                "deal_busy_s": self.deal_busy_s,
+                "paused": self._paused,
+                "size": self._size,
+                "max_priority": self.max_priority,
+                "n_slices": self._trees.n_slices,
+            }
+        d["writeback_lag_ms"] = self._wb_lag.snapshot_dict()
+        d["ring_depths"] = [r.depth() for r in self._rings]
+        d["ring_capacity"] = self.ring_capacity
+        return d
+
+    def close(self) -> None:
+        REGISTRY.unregister_provider("sampler", self.sampler_stats)
+        for r in self._rings:
+            r.close()

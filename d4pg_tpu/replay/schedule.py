@@ -12,6 +12,7 @@ lets it live inside checkpointed train state and stay exact across resume.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 
 
 @dataclasses.dataclass(frozen=True)
@@ -24,3 +25,56 @@ class LinearSchedule:
         """Linear interpolation initial_p -> final_p, clamped after T."""
         frac = min(float(t) / float(self.schedule_timesteps), 1.0)
         return self.initial_p + frac * (self.final_p - self.initial_p)
+
+
+class SharedBetaSchedule:
+    """One PER-beta anneal clock shared by every sampler in the process.
+
+    The PR-10 defect this fixes: with N learner replicas each replica
+    annealed beta off its OWN ``steps_done``, so two replicas at the same
+    global training step could hand different IS-weight exponents to the
+    same buffer — the anneal rate scaled with N and the weights stopped
+    being a function of training progress.
+
+    Design: the only shared mutable state is an ``itertools.count`` step
+    source (``next()`` is a single bytecode under CPython's GIL, so
+    claiming ticks is lock-free and never double-counts), and
+    :meth:`beta_at` is a PURE function of an explicit step — two callers
+    that hold the same ``t`` compute bit-identical beta no matter how
+    their claims interleave. ``completed()`` is an advisory progress
+    snapshot (benign read race; purity of ``beta_at`` is what the
+    concurrency regression test pins, not snapshot freshness).
+    """
+
+    def __init__(self, beta0: float = 0.4, beta_steps: int = 100_000,
+                 start_step: int = 0):
+        self.beta0 = float(beta0)
+        self.beta_steps = int(beta_steps)
+        self._steps = itertools.count(int(start_step))
+        self._completed = int(start_step)  # advisory, monotone-ish
+
+    def current_step(self) -> int:
+        """Claim-free read of the current global step: the value the next
+        claimer WOULD get. Callers snapshot this once per chunk and feed
+        it back to :meth:`beta_at` so beta is constant within the chunk
+        (exactly the legacy single-replica ``_beta`` behavior). Named
+        uniquely on purpose: ``step`` would name-collide with
+        ``WeightStore.step`` in the lint lock graph's call resolution."""
+        return self._completed
+
+    def beta_at(self, t: int) -> float:
+        """Pure linear anneal beta0 -> 1.0 over ``beta_steps`` — the same
+        expression ``LearnerReplica._beta`` used, so single-replica runs
+        stay bitwise identical."""
+        frac = min(1.0, t / max(1, self.beta_steps))
+        return self.beta0 + (1.0 - self.beta0) * frac
+
+    def advance(self, n: int) -> int:
+        """Consume ``n`` anneal ticks; returns the first claimed tick.
+        GIL-atomic per tick — concurrent replicas never claim the same
+        tick twice and the clock never runs backwards."""
+        first = next(self._steps)
+        for _ in range(int(n) - 1):
+            next(self._steps)
+        self._completed = first + int(n)
+        return first

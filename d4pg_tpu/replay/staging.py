@@ -16,12 +16,13 @@ single-``device_put`` + single-jitted-commit fused dispatch.
 from __future__ import annotations
 
 import itertools
+import time
 from collections import deque
 from typing import Callable, Iterator
 
 import jax
 
-from d4pg_tpu.core.locking import TieredLock
+from d4pg_tpu.core.locking import TieredCondition, TieredLock
 from d4pg_tpu.obs.registry import REGISTRY
 
 
@@ -212,3 +213,98 @@ class DeviceStager:
     def __iter__(self) -> Iterator:
         while True:
             yield self.next()
+
+
+class DealtBlockRing:
+    """Bounded ring of ready-to-train dealt blocks, one per learner
+    replica (the sample-on-ingest plane, ``replay/sampler.py``).
+
+    Ownership: single producer — the commit thread's dealer — and a
+    single consumer — the owning replica. The dealer reserves room under
+    its own ``sampler``-tier critical section (``room()``) and pushes
+    AFTER releasing it; since only consumers shrink the queue between
+    the reservation and the push, a reserved push can only fail if the
+    ring was closed. All queue state lives under one bottom-tier
+    ``ring`` condition, so the replica's blocking ``pop`` holds nothing
+    above the leaf tier — the replica sample path never touches the
+    buffer lock.
+    """
+
+    def __init__(self, capacity: int = 4):
+        self.capacity = max(1, int(capacity))
+        self._cond = TieredCondition("ring")
+        self._q: deque = deque()
+        self._closed = False
+        # Demand kick, set by ReplayService.attach_dealer: called after a
+        # pop frees room — with the ring condition RELEASED, so the
+        # callback may take the commit condition at top level (a ring ->
+        # commit ascent under the leaf lock would be the merge-wedge
+        # shape) — to wake the commit loop for an immediate top-up tick.
+        # Without it the ring refills only on ingest commits and the
+        # ~10 Hz idle tick, and a consumer faster than the commit cadence
+        # starves on an almost-always-empty ring.
+        self.on_room: Callable[[], None] | None = None
+
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed
+
+    def room(self) -> int:
+        with self._cond:
+            return 0 if self._closed else max(0, self.capacity - len(self._q))
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    def offer(self, block) -> bool:
+        """Producer push (named uniquely on purpose: ``push`` would
+        name-collide with ``HostStagingRing.push`` in the lint lock
+        graph's call resolution, manufacturing a ring->ring edge)."""
+        with self._cond:
+            if self._closed or len(self._q) >= self.capacity:
+                return False
+            self._q.append(block)
+            self._cond.notify_all()
+            return True
+
+    def pop(self, timeout: float | None = None):
+        """Next dealt block, blocking up to ``timeout`` seconds (forever
+        when None); None on timeout or close."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._q:
+                if self._closed:
+                    return None
+                if deadline is None:
+                    self._cond.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    self._cond.wait(remaining)
+            block = self._q.popleft()
+            self._cond.notify_all()
+        kick = self.on_room
+        if kick is not None:
+            kick()
+        return block
+
+    def clear(self) -> int:
+        """Drop all queued blocks (replica respawn: a fresh consumer must
+        not train on blocks dealt to its dead predecessor mid-kill).
+        Returns the number dropped."""
+        with self._cond:
+            n = len(self._q)
+            self._q.clear()
+            self._cond.notify_all()
+        kick = self.on_room
+        if n and kick is not None:
+            kick()
+        return n
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()

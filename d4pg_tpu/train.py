@@ -990,26 +990,51 @@ def train(cfg: ExperimentConfig) -> dict:
     # (generation, version) sequence (learner/aggregator.py).
     aggregator = None
     replica_failures: dict[int, int] = {}
-    if cfg.learners > 1:
+    if cfg.learners > 1 or cfg.sample_on_ingest:
         if fused:
             raise ValueError(
-                "--learners > 1 needs the host-sampled replay path "
-                "(fused device replay is single-consumer by construction "
-                "— pass --fused_replay off)")
+                "--learners > 1 / --sample_on_ingest need the host-sampled "
+                "replay path (fused device replay is single-consumer by "
+                "construction — pass --fused_replay off)")
         if multi_host or mesh is not None:
             raise ValueError(
-                "--learners > 1 composes with single-host unmeshed "
-                "learners only (scale within a host first)")
+                "--learners > 1 / --sample_on_ingest compose with "
+                "single-host unmeshed learners only (scale within a host "
+                "first)")
+        if cfg.sample_on_ingest and not cfg.prioritized_replay:
+            raise ValueError(
+                "--sample_on_ingest is the PER dealer — it needs "
+                "--p_replay (dealt blocks carry IS weights)")
         from d4pg_tpu.learner.aggregator import Aggregator
         from d4pg_tpu.learner.replica import LearnerReplica
+        from d4pg_tpu.replay.schedule import SharedBetaSchedule
 
+        n_learners = max(1, cfg.learners)
+        # one anneal clock for every sampler in the process: N replicas
+        # at the same global step use the same beta (and the dealer
+        # stamps it onto the blocks it deals)
+        beta_sched = SharedBetaSchedule(beta0=cfg.per_beta0,
+                                        beta_steps=cfg.per_beta_steps)
+        dealt_rings: list = []
+        if cfg.sample_on_ingest:
+            from d4pg_tpu.replay.sampler import SampleDealer
+            from d4pg_tpu.replay.staging import DealtBlockRing
+
+            dealt_rings = [DealtBlockRing(4) for _ in range(n_learners)]
+            dealer = SampleDealer(
+                cfg.memory_size, dealt_rings,
+                n_shards=cfg.ingest_shards, k=K,
+                batch_size=cfg.batch_size, alpha=cfg.per_alpha,
+                beta_schedule=beta_sched,
+                min_size=max(1, cfg.batch_size), seed=cfg.seed)
+            service.attach_dealer(dealer)
         aggregator = Aggregator(
             weights, mode=cfg.agg_mode, clip=cfg.agg_clip,
             # actors pull acting params only; the full 4-subtree merge
             # tree stays between replicas and aggregator
             extract=lambda tree: tree["actor_params"],
             norm_stats=_norm_snapshot)
-        for i in range(cfg.learners):
+        for i in range(n_learners):
             # identical network init across replicas, decorrelated
             # sampling/noise keys (replica 0 keeps the original chain).
             # Every replica gets its OWN buffer copy: updates donate
@@ -1024,9 +1049,12 @@ def train(cfg: ExperimentConfig) -> dict:
                 batch_size=cfg.batch_size,
                 prioritized=cfg.prioritized_replay, alpha=cfg.per_alpha,
                 beta0=cfg.per_beta0, beta_steps=cfg.per_beta_steps,
-                service=service))
-        print(f"multi-learner plane: {cfg.learners} replicas, "
-              f"mode={cfg.agg_mode} clip={cfg.agg_clip}", flush=True)
+                service=service,
+                dealt_ring=dealt_rings[i] if dealt_rings else None,
+                beta_schedule=beta_sched))
+        print(f"learner plane: {n_learners} replicas, "
+              f"mode={cfg.agg_mode} clip={cfg.agg_clip} "
+              f"sample_on_ingest={cfg.sample_on_ingest}", flush=True)
 
     def train_steps_multi(n: int):
         """Fan the cycle's n grad steps across the replicas: each runs

@@ -37,7 +37,7 @@ def test_hierarchy_table_shape():
     # plane's condition wedged between the weight server and the store
     assert list(HIERARCHY) == ["service", "buffer", "replica", "agg",
                                "commit", "wrelay", "wserve", "pserve",
-                               "wstore", "shard", "ring"]
+                               "wstore", "shard", "sampler", "ring"]
     tiers = list(HIERARCHY.values())
     assert tiers == sorted(tiers, reverse=True)
     assert len(set(tiers)) == len(tiers)

@@ -52,6 +52,30 @@ def test_native_matches_numpy_trees(rng):
         np.testing.assert_allclose(nat.get(probe), s.get(probe), rtol=1e-12)
 
 
+def test_native_set_get_accept_chunk_shaped_indices(rng):
+    """[K, B] chunk indices (what ``update_priorities`` receives from the
+    K-chunk sample paths) must apply ALL K*B writes, matching the numpy
+    trees' fancy-assignment semantics — the C ABI takes an element count,
+    and ``len()`` of a 2D array is its outer dim (the silent-drop
+    regression the sample-on-ingest bitwise oracle caught)."""
+    from d4pg_tpu.replay.native import NativePerTrees
+
+    N = 256
+    nat = NativePerTrees(N)
+    s = SumTree(N)
+    idx = rng.integers(0, N, size=(4, 32))
+    vals = rng.random((4, 32)) + 1e-6
+    nat.set(idx, vals)
+    s.set(idx, vals)
+    assert nat.sum() == s.sum()
+    np.testing.assert_array_equal(nat.get(idx), s.get(idx))
+    assert nat.get(idx).shape == idx.shape
+    mass = rng.uniform(0, s.sum(), size=(2, 16))
+    np.testing.assert_array_equal(nat.find_prefixsum(mass),
+                                  s.find_prefixsum(mass))
+    assert nat.find_prefixsum(mass).shape == mass.shape
+
+
 def test_native_backend_in_buffer(rng):
     """PER buffer behaves identically under both backends (same seed)."""
     def run(backend):

@@ -1,0 +1,487 @@
+"""Sample-on-ingest-plane tests (marker ``sampler``): the shard-slice
+PER tree merge math (replay/sampler.ShardSlicePerTrees), the dealer's
+bitwise block oracle against the legacy host sample path, the
+N=1 dealt-replica ⇔ host-replica state oracle, the shared beta anneal
+clock (the PR-10 per-caller-anneal regression), write-back generation
+fencing, the fenced-frame-never-dealt invariant, the dealer chaos
+smoke, the ``sampler`` obs provider + ``deal`` trace span, and the
+bench-artifact ``sampler`` schema gate."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.obs.registry import REGISTRY
+from d4pg_tpu.replay.prioritized import PrioritizedReplayBuffer
+from d4pg_tpu.replay.sampler import SampleDealer, ShardSlicePerTrees
+from d4pg_tpu.replay.schedule import SharedBetaSchedule
+from d4pg_tpu.replay.segment_tree import MinTree, SumTree
+from d4pg_tpu.replay.staging import DealtBlockRing
+from d4pg_tpu.replay.uniform import TransitionBatch
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.sampler
+
+
+def _batch(rng, n, obs_dim=6, act_dim=3):
+    return TransitionBatch(
+        obs=rng.standard_normal((n, obs_dim)).astype(np.float32),
+        action=rng.uniform(-1, 1, (n, act_dim)).astype(np.float32),
+        reward=rng.standard_normal(n).astype(np.float32),
+        next_obs=rng.standard_normal((n, obs_dim)).astype(np.float32),
+        done=np.zeros(n, np.float32),
+        discount=np.full(n, 0.99, np.float32))
+
+
+# ------------------------------------------- shard-slice tree merge ----
+
+@pytest.mark.parametrize("backend", ["numpy", "auto"])
+@pytest.mark.parametrize("k", [1, 2, 4])
+def test_slice_merge_bitwise_equals_single_tree(rng, k, backend):
+    """Totals, mins and the batched inverse-CDF descent over K partial
+    slice trees must equal ONE flat SumTree/MinTree over the same slots
+    BITWISE — the merge is structural (same pairwise bracketing), not a
+    cumsum, so there is no float tolerance to hide behind.
+    ``backend='numpy'`` pins the slice merge math itself; ``'auto'``
+    pins that the native-delegated backing (when the lib is loadable —
+    it silently falls back to the same numpy path otherwise) observes
+    the identical contract."""
+    cap = 64
+    merged = ShardSlicePerTrees(cap, k, backend=backend)
+    s, m = SumTree(cap), MinTree(cap)
+    for _ in range(25):
+        idx = rng.integers(0, cap, size=int(rng.integers(1, 17)))
+        vals = rng.uniform(0.01, 5.0, size=idx.size)
+        merged.set(idx, vals)
+        s.set(idx, vals)
+        m.set(idx, vals)
+        assert merged.total() == s.sum()
+        assert merged.min() == m.min()
+        np.testing.assert_array_equal(merged.get(idx), s.get(idx))
+        prefixes = rng.uniform(0.0, s.sum(), size=33)
+        np.testing.assert_array_equal(merged.find_prefixsum(prefixes),
+                                      s.find_prefixsum(prefixes))
+
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_slice_merge_with_all_zero_priority_slices(rng, k):
+    """Slices holding no mass (all-zero priorities — e.g. a shard slice
+    nothing has committed into yet) must not perturb the draw: the
+    descent lands in live slices exactly where the single tree does."""
+    cap = 32
+    merged = ShardSlicePerTrees(cap, k, backend="numpy")
+    s = SumTree(cap)
+    # populate ONLY slice 0's slot range; other slices stay all-zero
+    idx = np.arange(cap // k)
+    vals = rng.uniform(0.1, 2.0, size=idx.size)
+    merged.set(idx, vals)
+    s.set(idx, vals)
+    assert merged.total() == s.sum()
+    prefixes = rng.uniform(0.0, s.sum(), size=50)
+    np.testing.assert_array_equal(merged.find_prefixsum(prefixes),
+                                  s.find_prefixsum(prefixes))
+    # a later write into a previously-zero slice repairs the top tree
+    hi = np.arange(cap - cap // k, cap)
+    hvals = rng.uniform(0.1, 2.0, size=hi.size)
+    merged.set(hi, hvals)
+    s.set(hi, hvals)
+    assert merged.total() == s.sum()
+    prefixes = rng.uniform(0.0, s.sum(), size=50)
+    np.testing.assert_array_equal(merged.find_prefixsum(prefixes),
+                                  s.find_prefixsum(prefixes))
+
+
+def test_slice_cap_one_edge(rng):
+    """n_slices == capacity: every slice is a single leaf and the top
+    tree does all the descent work."""
+    cap = 8
+    merged = ShardSlicePerTrees(cap, cap, backend="numpy")
+    s = SumTree(cap)
+    idx = np.arange(cap)
+    vals = rng.uniform(0.1, 3.0, size=cap)
+    merged.set(idx, vals)
+    s.set(idx, vals)
+    assert merged.slice_cap == 1
+    assert merged.total() == s.sum()
+    prefixes = rng.uniform(0.0, s.sum(), size=40)
+    np.testing.assert_array_equal(merged.find_prefixsum(prefixes),
+                                  s.find_prefixsum(prefixes))
+
+
+# ------------------------------------------- dealer block oracle -------
+
+def test_dealer_blocks_bitwise_equal_legacy_sample_chunk(rng):
+    """Twin seeded setups: the dealer draws through the merged slice
+    trees, legacy draws through ``sample_chunk`` on an identically
+    filled buffer. Indices, weights, dtypes, beta and the gathered rows
+    must match exactly — across priority write-back rounds too (the
+    capacity-1 ring makes each dealt block settle its predecessor's
+    write-back before drawing, the legacy update-then-sample order)."""
+    CAP, K, B, SEED, ROUNDS = 128, 3, 8, 11, 4
+    legacy = PrioritizedReplayBuffer(CAP, 6, 3, alpha=0.6, seed=SEED)
+    twin = PrioritizedReplayBuffer(CAP, 6, 3, alpha=0.6, seed=SEED)
+    ring = DealtBlockRing(capacity=1)
+    dealer = SampleDealer(CAP, [ring], n_shards=2, k=K, batch_size=B,
+                          alpha=0.6,
+                          beta_schedule=SharedBetaSchedule(0.4, 1000),
+                          min_size=1, seed=SEED, ring_capacity=1)
+    legacy_sched = SharedBetaSchedule(0.4, 1000)
+
+    dealer.pause_dealing()  # paused deals never touch the RNG
+    for i in range(3):
+        batch = _batch(rng, 48)
+        legacy.add(batch)
+        dealer.ingest_and_deal([(twin.add(batch), i, None)], twin)
+    dealer.resume_dealing()
+
+    for _ in range(ROUNDS):
+        dealt = dealer.ingest_and_deal((), twin)  # idle top-up tick
+        assert len(dealt) == 1
+        dealer.publish(dealt)
+        blk = ring.pop(timeout=0)
+        assert blk is not None
+
+        lbeta = legacy_sched.beta_at(legacy_sched.current_step())
+        lb, lw, lidx = legacy.sample_chunk(K, B, beta=lbeta,
+                                           weight_base=legacy.weight_base())
+        legacy_sched.advance(K)
+
+        np.testing.assert_array_equal(blk.idx, lidx)
+        np.testing.assert_array_equal(blk.weights, lw)
+        assert blk.weights.dtype == lw.dtype == np.float32
+        assert blk.beta == lbeta
+        for a, b in zip(blk.batches, lb):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(blk.gen, legacy.generation[lidx])
+
+        # write back the same TD magnitudes through both paths
+        td = np.asarray(rng.uniform(0.05, 3.0, size=lidx.shape))
+        legacy.update_priorities(lidx, td, generation=legacy.generation[lidx])
+        dealer.queue_writeback(blk.idx, td, blk.gen)
+    assert dealer.dealt_blocks == ROUNDS
+    dealer.close()
+
+
+# ------------------------------------------- N=1 replica state oracle --
+
+def test_n1_dealt_replica_bitwise_equals_host_replica(rng):
+    """ONE replica consuming dealt blocks must land bit-for-bit the
+    state a host-sampled replica reaches over an identically-filled,
+    identically-seeded service — run in pause/resume lockstep with a
+    capacity-1 ring so each side's priority write-back settles before
+    the next draw, exactly the legacy update-then-sample order."""
+    import jax
+
+    from d4pg_tpu.distributed.replay_service import ReplayService
+    from d4pg_tpu.distributed.weights import WeightStore
+    from d4pg_tpu.learner import D4PGConfig, init_state
+    from d4pg_tpu.learner.aggregator import Aggregator
+    from d4pg_tpu.learner.replica import PARAM_FIELDS, LearnerReplica
+
+    OBS, ACT, CAP, K, B, SEED, ROUNDS = 5, 2, 256, 2, 8, 5, 3
+    config = D4PGConfig(obs_dim=OBS, act_dim=ACT, v_min=-10, v_max=10,
+                        n_atoms=11, hidden=(16, 16))
+    blocks = [_batch(rng, 48, OBS, ACT) for _ in range(2)]
+
+    svc_h = ReplayService(
+        PrioritizedReplayBuffer(CAP, OBS, ACT, alpha=0.6, seed=SEED))
+    svc_d = ReplayService(
+        PrioritizedReplayBuffer(CAP, OBS, ACT, alpha=0.6, seed=SEED))
+    ring = DealtBlockRing(capacity=1)
+    dealer = SampleDealer(CAP, [ring], n_shards=1, k=K, batch_size=B,
+                          alpha=0.6,
+                          beta_schedule=SharedBetaSchedule(0.4, 1000),
+                          min_size=1, seed=SEED, ring_capacity=1)
+    dealer.pause_dealing()  # fill first, deal in lockstep below
+    svc_d.attach_dealer(dealer)
+    for b in blocks:
+        svc_h.add(b, actor_id="oracle")
+        svc_d.add(b, actor_id="oracle")
+    svc_h.flush(timeout=10.0)
+    svc_d.flush(timeout=10.0)
+
+    agg_h = Aggregator(WeightStore())
+    agg_d = Aggregator(WeightStore())
+    rep_h = LearnerReplica(0, config, agg_h,
+                           init_state(config, jax.random.key(0)),
+                           k=K, batch_size=B, service=svc_h,
+                           beta_schedule=SharedBetaSchedule(0.4, 1000))
+    rep_d = LearnerReplica(0, config, agg_d,
+                           init_state(config, jax.random.key(0)),
+                           k=K, batch_size=B, service=svc_d,
+                           dealt_ring=ring,
+                           beta_schedule=SharedBetaSchedule(0.4, 1000))
+    assert rep_h.mode == "host" and rep_d.mode == "dealt"
+
+    def wait_block(timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while ring.depth() == 0:
+            assert time.monotonic() < deadline, "dealer never dealt a block"
+            time.sleep(0.01)
+
+    for _ in range(ROUNDS):
+        # dealt side: resume -> the commit thread's idle tick settles the
+        # previous round's write-back and deals ONE block (ring cap 1) ->
+        # pause -> consume it
+        dealer.resume_dealing()
+        wait_block()
+        dealer.pause_dealing()
+        rep_d.run_round(K)
+        rep_h.run_round(K)
+
+    for f in PARAM_FIELDS:
+        a = jax.device_get(getattr(rep_h.state, f))
+        b = jax.device_get(getattr(rep_d.state, f))
+        jax.tree_util.tree_map(np.testing.assert_array_equal, a, b)
+    assert rep_h.steps_done == rep_d.steps_done == ROUNDS * K
+    rep_h.close()
+    rep_d.close()
+    agg_h.close()
+    agg_d.close()
+    svc_h.close()
+    svc_d.close()
+
+
+# ------------------------------------------- shared beta clock ---------
+
+def test_shared_beta_two_replicas_same_step_same_beta():
+    """The PR-10 regression: two replicas sampling concurrently must
+    read the IDENTICAL beta at the same global step — the anneal clock
+    is shared, not per-caller (which scaled the anneal rate with N)."""
+    sched = SharedBetaSchedule(beta0=0.4, beta_steps=1000)
+    barrier = threading.Barrier(2)
+    out: list = [None, None]
+
+    def reader(i):
+        barrier.wait()
+        t = sched.current_step()
+        out[i] = (t, sched.beta_at(t))
+
+    threads = [threading.Thread(target=reader, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert out[0] == out[1]
+
+    # concurrent claims never double-count: 4 threads x 250 steps
+    # advance the clock by exactly 1000, to the anneal ceiling
+    def advancer():
+        for _ in range(50):
+            sched.advance(5)
+
+    threads = [threading.Thread(target=advancer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sched.current_step() == 1000
+    assert sched.beta_at(sched.current_step()) == 1.0
+
+
+def test_shared_beta_matches_legacy_formula():
+    sched = SharedBetaSchedule(beta0=0.4, beta_steps=100)
+    for t in (0, 1, 50, 100, 250):
+        expect = 0.4 + (1.0 - 0.4) * min(1.0, t / 100)
+        assert sched.beta_at(t) == expect
+
+
+# ------------------------------------------- write-back fencing --------
+
+def test_writeback_generation_fence_drops_stale(rng):
+    """A write-back whose slot was overwritten between draw and settle
+    must be DROPPED, not applied to the new occupant's priority."""
+    CAP, K, B = 64, 1, 4
+    ring = DealtBlockRing(capacity=2)
+    buf = PrioritizedReplayBuffer(CAP, 6, 3, alpha=0.6, seed=0)
+    dealer = SampleDealer(CAP, [ring], n_shards=1, k=K, batch_size=B,
+                          min_size=1, seed=0, ring_capacity=2)
+    idx = buf.add(_batch(rng, 16))
+    dealer.publish(dealer.ingest_and_deal([(idx, 0, None)], buf))
+    blk = ring.pop(timeout=0)
+    assert blk is not None
+    # overwrite one drawn slot (its generation bumps), then write back
+    victim = int(blk.idx.ravel()[0])
+    dealer.ingest_and_deal([(np.array([victim]), 1, None)], buf)
+    before = dealer._trees.get(blk.idx.ravel()).copy()
+    dealer.queue_writeback(blk.idx, np.full(blk.idx.shape, 9.0), blk.gen)
+    dealer.drain_writebacks_for_shard(0)
+    after = dealer._trees.get(blk.idx.ravel())
+    stale = blk.idx.ravel() == victim
+    assert dealer.writeback_dropped_stale == int(stale.sum())
+    # the overwritten slot kept its fresh-insert priority...
+    np.testing.assert_array_equal(after[stale], before[stale])
+    # ...while live slots took the update (9.0 ** alpha)
+    if (~stale).any():
+        np.testing.assert_array_equal(after[~stale],
+                                      np.full(int((~stale).sum()), 9.0**0.6))
+    dealer.close()
+
+
+def test_fenced_frame_never_dealt(rng):
+    """A frame stamped with a pre-restart generation fences at admission
+    — it inserts no rows, so it is STRUCTURALLY undealable; the audit
+    counter stays 0 while fresh frames keep dealing."""
+    from d4pg_tpu.distributed import transport
+    from d4pg_tpu.distributed.replay_service import ReplayService
+
+    svc = ReplayService(PrioritizedReplayBuffer(256, 6, 3, seed=0),
+                        generation=1)
+    ring = DealtBlockRing(capacity=2)
+    dealer = SampleDealer(256, [ring], n_shards=1, k=1, batch_size=4,
+                          min_size=1, seed=0, ring_capacity=2, audit=True)
+    svc.attach_dealer(dealer)
+    # encode_raw returns length-prefixed wire bytes; admission takes the
+    # bare payload the receiver would hand it
+    stale = transport.encode_raw("corpse", _batch(rng, 8), True,
+                                 generation=0)
+    assert svc.add_payload(stale[transport._HEADER.size:],
+                           codec="raw") is True  # declared loss, not error
+    svc.add(_batch(rng, 16), actor_id="live")
+    svc.flush(timeout=10.0)
+    deadline = time.monotonic() + 5.0
+    while ring.depth() == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    stats = svc.ingest_stats()
+    assert stats["fenced_frames"] == 1 and stats["fenced_rows"] == 8
+    assert svc.env_steps == 16          # fenced rows never inserted
+    assert ring.depth() > 0             # live rows still deal
+    assert dealer.dealt_dead_tickets == 0
+    svc.close()
+
+
+# ------------------------------------------- dealt ring ----------------
+
+def test_dealt_ring_capacity_close_and_clear():
+    ring = DealtBlockRing(capacity=2)
+    assert ring.room() == 2
+    assert ring.offer("a") and ring.offer("b")
+    assert not ring.offer("c")          # full: unreserved offer fails
+    assert ring.depth() == 2 and ring.room() == 0
+    assert ring.pop(timeout=0) == "a"
+    assert ring.offer("c")
+    assert ring.clear() == 2            # respawn path drops the backlog
+    assert ring.pop(timeout=0.01) is None
+    ring.close()
+    assert ring.closed and ring.room() == 0
+    assert not ring.offer("d")
+    assert ring.pop(timeout=None) is None  # close unblocks a waiting pop
+
+
+# ------------------------------------------- obs plane -----------------
+
+@pytest.mark.obs
+def test_sampler_provider_and_deal_span(rng):
+    """The ``sampler`` registry provider must export the dealt counters
+    + write-back lag histogram, and a dealt block must commit a ``deal``
+    span hanging off its newest constituent frame's committed trace."""
+    from d4pg_tpu.obs import trace as obs_trace
+
+    obs_trace.RECORDER.reset()
+    obs_trace.RECORDER.enable(sample_rate=1.0)
+    REGISTRY.histogram("sampler.writeback_lag_ms").reset()
+    ring = DealtBlockRing(capacity=1)
+    buf = PrioritizedReplayBuffer(64, 6, 3, alpha=0.6, seed=0)
+    dealer = SampleDealer(64, [ring], n_shards=1, k=1, batch_size=4,
+                          min_size=1, seed=0, ring_capacity=1)
+    tid = 7
+    obs_trace.RECORDER.begin(tid, time.monotonic())
+    obs_trace.RECORDER.record_span(tid, "admission")
+    obs_trace.RECORDER.mark_committed([tid])
+    dealt = dealer.ingest_and_deal([(buf.add(_batch(rng, 16)), 0, tid)], buf)
+    assert len(dealt) == 1
+    dealer.publish(dealt)
+    blk = dealt[0][1]
+    assert blk.tid == tid
+    dealer.queue_writeback(blk.idx, np.full(blk.idx.shape, 1.0), blk.gen)
+    dealer.drain_writebacks_for_shard(0)
+    obs_trace.RECORDER.mark_grad()
+    lat = obs_trace.RECORDER.latency_block()
+    assert lat["orphans"] == 0
+    assert lat["stages"]["commit_to_deal"]["n"] >= 1
+    assert lat["stages"]["deal_to_grad"]["n"] >= 1
+
+    prov = REGISTRY.export()["sampler"]
+    assert prov["dealt_blocks"] == 1
+    assert prov["dealt_rows"] == 4
+    assert prov["dealer_queue_depth"] == 0  # write-back drained
+    assert prov["writeback_lag_ms"]["n"] == 1
+    assert prov["ring_capacity"] == 1
+    assert prov["ring_depths"] == [1]
+    dealer.close()
+    obs_trace.RECORDER.disable()
+    obs_trace.RECORDER.reset()
+
+
+# ------------------------------------------- chaos smoke ---------------
+
+@pytest.mark.fleet
+def test_sampler_chaos_smoke():
+    """A small dealer-mode chaos run (consumer kill + stale-generation
+    injection under sender chaos) must pass the gating oracles — the
+    full-size version is the bench artifact's ``sampler`` chaos row."""
+    from d4pg_tpu.fleet.sampler_chaos import (
+        SamplerChaosConfig,
+        run_sampler_chaos,
+    )
+
+    rep = run_sampler_chaos(SamplerChaosConfig(
+        sample_path="dealer", n_actors=4, duration_s=3.0,
+        rows_per_sec=40.0, learner_kills=1, stale_frames=3, seed=3))
+    assert rep["deadlocks"] == 0
+    assert rep["hierarchy_violations"] == 0
+    assert rep["trace_orphans"] == 0
+    assert rep["sampler"]["dealt_dead_tickets"] == 0
+    assert rep["consumer"]["sample_path_buffer_acqs"] == 0
+    assert rep["consumer"]["consumer_kills"] == 1
+    assert rep["consumer"]["stale_frames_injected"] == 3
+    assert rep["fenced_frames"] == 3
+    assert rep["sampler"]["dealt_blocks"] > 0
+    assert rep["consumer"]["blocks_consumed"] > 0
+
+
+# ------------------------------------------- artifact gate -------------
+
+@pytest.mark.obs
+def test_fleet_artifact_sampler_schema():
+    """The newest committed fleet artifact must carry the sampler block:
+    the dealer-vs-host A/B pair (the dealer consume path pinned at ZERO
+    buffer-lock acquisitions, wire-to-grad p95 on both arms) and one
+    dealer chaos row passing every gating oracle — a later PR that
+    drops any of it fails tier-1 here."""
+    arts = sorted(glob.glob(os.path.join(
+        REPO_ROOT, "docs", "evidence", "fleet", "fleet_*.json")))
+    assert arts, "no committed fleet artifact"
+    with open(arts[-1]) as f:
+        artifact = json.load(f)
+    blk = artifact.get("sampler")
+    assert blk, "newest fleet artifact lost its sampler block"
+    assert blk["metric"] == "fleet_sampler" and blk["schema"] == 1
+    ab = blk["ab"]
+    assert ab["dealer"]["sample_path_buffer_acqs"] == 0
+    assert ab["host"]["sample_path_buffer_acqs"] > 0
+    for arm in ("dealer", "host"):
+        assert ab[arm]["wire_to_grad_p95_ms"] is not None
+        assert ab[arm]["blocks_consumed"] > 0
+        assert ab[arm]["deadlocks"] == 0
+        assert ab[arm]["hierarchy_violations"] == 0
+        assert ab[arm]["trace_orphans"] == 0
+    assert ab["dealer"]["sampler"]["dealt_blocks"] > 0
+    chaos = blk["chaos"]
+    assert chaos["metric"] == "sampler_chaos" and chaos["schema"] == 1
+    assert chaos["sample_path"] == "dealer"
+    assert chaos["deadlocks"] == 0
+    assert chaos["hierarchy_violations"] == 0
+    assert chaos["trace_orphans"] == 0
+    assert chaos["sampler"]["dealt_dead_tickets"] == 0
+    assert chaos["consumer"]["sample_path_buffer_acqs"] == 0
+    assert chaos["consumer"]["consumer_kills"] >= 1
+    assert chaos["fenced_frames"] >= 1
