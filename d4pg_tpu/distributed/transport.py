@@ -35,15 +35,31 @@ from typing import Callable, Optional
 
 import numpy as np
 
+# All framing facts (magics, header structs, flag bits, payload cap) come
+# from the declared wire registry — the single source of truth every
+# plane imports; see core/wire.py and `python -m d4pg_tpu.lint --wire`.
+from d4pg_tpu.core.wire import (
+    F_COUNT as _F_COUNT,
+    F_GEN as _F_GEN,
+    F_TRACE as _F_TRACE,
+    FRAME_HEADER as _HEADER,
+    GEN_GREETING as _GEN_GREETING,
+    MAGIC_GEN_GREETING as _MAGIC_GEN,
+    MAGIC_INGEST_V1 as _MAGIC,
+    MAGIC_INGEST_V2 as _MAGIC_RAW,
+    MAX_PAYLOAD,
+    RAW_FIELD_PRE as _RAW_FIELD_PRE,
+    RAW_GEN as _RAW_GEN,
+    RAW_NFIELDS as _RAW_NFIELDS,
+    RAW_PRE as _RAW_PRE,
+    RAW_TRACE as _RAW_TRACE,
+    ingest_v2_layout as _ingest_v2_layout,
+)
 from d4pg_tpu.obs.flight import record_event
 from d4pg_tpu.replay.uniform import TransitionBatch
 
-_MAGIC = 0xD4F6  # v1 frames: npz payload (self-describing, slow to parse)
-_MAGIC_RAW = 0xD4F8  # v2 frames: raw column payload (fixed header + blobs)
-_HEADER = struct.Struct("!II")
 _NONCE_LEN = 16
 _MAC_LEN = 32  # sha256 digest
-MAX_PAYLOAD = 64 << 20  # 64 MiB: far above any sane batch/param frame
 
 CODECS = ("npz", "raw")
 
@@ -154,19 +170,16 @@ def _decode(payload: bytes) -> tuple[str, TransitionBatch, bool]:
 # Like the trace extension, it is header-only readable and absent bytes
 # keep old frames byte-identical forever.
 
-_RAW_PRE = struct.Struct("!BB")  # flags (bit0 count, bit1 trace), len(aid)
-_RAW_TRACE = struct.Struct("!Qd")  # trace id, birth timestamp
-_RAW_GEN = struct.Struct("!I")  # service generation id
-_F_COUNT = 0x01
-_F_TRACE = 0x02
-_F_GEN = 0x04
-
-# post-handshake receiver greeting: magic + current service generation.
-# Opt-in on BOTH sides (receiver configured with a generation source,
-# sender constructed with expect_generation=True) so the legacy wire
-# conversation is untouched byte for byte.
-_MAGIC_GEN = 0xD4FA
-_GEN_GREETING = struct.Struct("!HI")
+# The structs and flag bits for both extensions are declared once in
+# core/wire.py (ingest-v2 row of the registry) and imported above:
+# _RAW_PRE "!BB" (flags, len(aid)), _RAW_TRACE "!Qd", _RAW_GEN "!I",
+# _F_COUNT/_F_TRACE/_F_GEN bits 0/1/2 of the ingest flag byte.
+#
+# Post-handshake receiver greeting (_MAGIC_GEN + _GEN_GREETING "!HI"):
+# magic + current service generation. Opt-in on BOTH sides (receiver
+# configured with a generation source, sender constructed with
+# expect_generation=True) so the legacy wire conversation is untouched
+# byte for byte.
 
 
 def encode_raw(actor_id: str, batch: TransitionBatch,
@@ -184,12 +197,12 @@ def encode_raw(actor_id: str, batch: TransitionBatch,
         head.append(_RAW_TRACE.pack(int(trace[0]), float(trace[1])))
     if generation is not None:
         head.append(_RAW_GEN.pack(int(generation) & 0xFFFFFFFF))
-    head.append(struct.pack("!B", len(batch)))
+    head.append(_RAW_NFIELDS.pack(len(batch)))
     blobs = []
     for v in batch:
         a = np.ascontiguousarray(v)
         ds = a.dtype.str.encode()
-        head.append(struct.pack("!BB", len(ds), a.ndim) + ds
+        head.append(_RAW_FIELD_PRE.pack(len(ds), a.ndim) + ds
                     + struct.pack(f"!{a.ndim}I", *a.shape))
         blobs.append(a.tobytes())
     payload = b"".join(head) + b"".join(blobs)
@@ -200,25 +213,28 @@ def _raw_header(payload: bytes):
     """Parse the v2 header: (actor_id, count, [(dtype, shape)], data_off,
     trace, generation) — ``trace`` is ``(trace_id, birth_ts)`` when the
     frame carries the tracing extension, ``generation`` the u32 service
-    generation when it carries the recovery extension; else None."""
+    generation when it carries the recovery extension; else None.
+
+    Extension offsets come from the registry's declared layout
+    (``wire.ingest_v2_layout``) rather than a hand-rolled running
+    offset, so the header-only readers and the full decoder can never
+    drift from the declared frame shape."""
     flags, laid = _RAW_PRE.unpack_from(payload, 0)
-    off = _RAW_PRE.size
-    actor_id = payload[off:off + laid].decode()
-    off += laid
+    layout = _ingest_v2_layout(flags, laid)
+    actor_id = payload[layout["aid"]:layout["aid"] + laid].decode()
     trace = None
-    if flags & _F_TRACE:
-        trace = _RAW_TRACE.unpack_from(payload, off)
-        off += _RAW_TRACE.size
+    if layout["trace"] >= 0:
+        trace = _RAW_TRACE.unpack_from(payload, layout["trace"])
     generation = None
-    if flags & _F_GEN:
-        (generation,) = _RAW_GEN.unpack_from(payload, off)
-        off += _RAW_GEN.size
-    (nf,) = struct.unpack_from("!B", payload, off)
-    off += 1
+    if layout["generation"] >= 0:
+        (generation,) = _RAW_GEN.unpack_from(payload, layout["generation"])
+    off = layout["fields"]
+    (nf,) = _RAW_NFIELDS.unpack_from(payload, off)
+    off += _RAW_NFIELDS.size
     fields = []
     for _ in range(nf):
-        lds, ndim = struct.unpack_from("!BB", payload, off)
-        off += 2
+        lds, ndim = _RAW_FIELD_PRE.unpack_from(payload, off)
+        off += _RAW_FIELD_PRE.size
         dtype = np.dtype(payload[off:off + lds].decode())
         off += lds
         shape = struct.unpack_from(f"!{ndim}I", payload, off)
@@ -764,6 +780,9 @@ class TransitionReceiver(ConnRegistry):
         self._generation = generation
         self._secret = secret
         self._max_payload = int(max_payload)
+        # hostile/corrupt frames dropped (bad magic, oversize, decode
+        # failure). Monotonic; reads are informational so no lock.
+        self.frames_rejected = 0
         self.num_shards = max(1, int(num_shards))
         self._servers: list[socket.socket] = []
         self._rr = 0  # round-robin shard cursor (fallback path)
@@ -845,7 +864,9 @@ class TransitionReceiver(ConnRegistry):
                     magic, length = _HEADER.unpack(header)
                     if (magic not in (_MAGIC, _MAGIC_RAW)
                             or length > self._max_payload):
-                        return  # corrupt or hostile stream; drop the connection
+                        # corrupt or hostile stream; drop the connection
+                        self.frames_rejected += 1
+                        return
                     payload = _recv_exact(conn, length)
                     if payload is None:
                         return
@@ -856,12 +877,15 @@ class TransitionReceiver(ConnRegistry):
                         continue
                     actor_id, batch, count = decode_frame(payload, codec)
                     self._on_batch(batch, actor_id, count)
-        except (OSError, ProtocolError, struct.error, ValueError, TypeError):
-            # peer died mid-frame / corrupt stream; just drop it. The
-            # non-ProtocolError types come out of decode_frame on a
-            # hostile-but-well-framed payload (_raw_header unpack,
-            # np.dtype on a garbage name, UnicodeDecodeError ⊂ ValueError)
+        except (ProtocolError, struct.error, ValueError, TypeError):
+            # hostile-but-well-framed payload rejected by decode_frame
+            # (_raw_header unpack, np.dtype on a garbage name,
+            # UnicodeDecodeError ⊂ ValueError): count it, drop the conn.
+            # Must precede OSError — ProtocolError ⊂ ConnectionError.
+            self.frames_rejected += 1
             return
+        except OSError:
+            return  # peer died mid-frame; not a rejection
         finally:
             self._unregister_conn(conn)
 

@@ -43,13 +43,21 @@ from __future__ import annotations
 
 import io
 import socket
-import struct
 import threading
 import time
+import zipfile
 import zlib
 
 import numpy as np
 
+# Frame shapes come from the declared wire registry (update-req
+# "!IIIIqqqdBII" header with payload crc32, update-ack "!IBqqdB");
+# see core/wire.py and ``python -m d4pg_tpu.lint --wire``.
+from d4pg_tpu.core.wire import (
+    MAGIC_UPDATE as _UPD_MAGIC,
+    UPDATE_ACK as _UPD_ACK,
+    UPDATE_HEADER as _UPD_HDR,
+)
 from d4pg_tpu.distributed.transport import (
     MAX_PAYLOAD,
     ConnRegistry,
@@ -62,10 +70,6 @@ from d4pg_tpu.distributed.weight_plane import decode_flat, encode_flat
 from d4pg_tpu.distributed.weight_server import _flatten, _unflatten
 from d4pg_tpu.obs.flight import record_event
 from d4pg_tpu.obs.trace import RECORDER as TRACE, new_trace_id
-
-_UPD_MAGIC = 0xD4AB
-_UPD_HDR = struct.Struct("!IIIIqqqdBII")
-_UPD_ACK = struct.Struct("!IBqqdB")
 
 STATUS_APPLIED = 0
 STATUS_FENCED = 1
@@ -110,6 +114,10 @@ def update_frame_meta(frame: bytes) -> dict:
         raise ProtocolError(f"bad update magic {magic:#x}")
     if length > MAX_PAYLOAD:
         raise ProtocolError(f"update payload {length}B exceeds MAX_PAYLOAD")
+    if codec_id > 2:
+        # must be a ProtocolError, not an IndexError out of the tuple
+        # lookup below: _serve only contains wire-format exceptions
+        raise ProtocolError(f"unknown update codec id {codec_id}")
     return {"replica_id": replica_id, "epoch": epoch,
             "generation": generation, "basis_version": basis_version,
             "step": step, "trace_id": trace_id, "birth_ts": birth_ts,
@@ -202,7 +210,12 @@ class AggregatorServer(ConnRegistry):
             return STATUS_FENCED, {"version": self._agg.version}
         try:
             meta, params = decode_update(frame)
-        except ProtocolError:
+        except (ProtocolError, ValueError, KeyError, TypeError, OSError,
+                zipfile.BadZipFile):
+            # ProtocolError covers length/crc tears; the rest come out
+            # of np.load/decode_flat on a crc-VALID but garbage body
+            # (the sender checksummed corrupt bytes). Either way: torn,
+            # counted, acked, connection stays alive.
             self.torn += 1
             if tid:
                 TRACE.terminal_shed(tid)

@@ -80,6 +80,7 @@ import struct
 import threading
 import time
 import weakref
+import zipfile
 import zlib
 from collections import OrderedDict
 
@@ -106,12 +107,19 @@ from d4pg_tpu.obs.flight import record_event
 from d4pg_tpu.obs.registry import REGISTRY
 from d4pg_tpu.obs.trace import RECORDER as TRACE, TERMINALS, new_trace_id
 
-_PLANE_MAGIC = 0xD4FC
-_PLANE_REQ = struct.Struct("!IqIBB")   # magic, have_version, have_gen, codec, flags
-_PLANE_RESP = struct.Struct("!IBII")   # magic, kind, crc32, len
+# Frame shapes come from the declared wire registry (weights-v2 rows:
+# _PLANE_REQ "!IqIBB" magic/have_version/have_gen/codec/flags,
+# _PLANE_RESP "!IBII" magic/kind/crc32/len); see core/wire.py and
+# ``python -m d4pg_tpu.lint --wire``.
+from d4pg_tpu.core.wire import (
+    MAGIC_WEIGHTS_V2 as _PLANE_MAGIC,
+    WEIGHTS_V2_REQ as _PLANE_REQ,
+    WEIGHTS_V2_RESP as _PLANE_RESP,
+    WFLAG_DELTA as _FLAG_DELTA,
+)
+
 _KIND_NONE = 0
 _KIND_FRAME = 1
-_FLAG_DELTA = 1
 
 CODECS = ("f32", "bf16", "int8")
 _CODEC_ID = {name: i for i, name in enumerate(CODECS)}
@@ -787,20 +795,33 @@ class WeightPlaneClient(ReconnectingClient):
         return self._accept(payload)
 
     def _accept(self, payload: bytes):
-        with np.load(io.BytesIO(payload)) as z:
-            meta_gen = int(z["__generation__"])
-            version = int(z["__version__"])
-            kind = int(z["__kind__"])
-            base_version = int(z["__base_version__"])
-            tid = int(z["__trace__"])
-            entries = {k: z[k] for k in z.files if not k.startswith("__")}
-            entries["__same__"] = (z["__same__"] if "__same__" in z.files
-                                   else np.frombuffer(b"[]", np.uint8))
-            entries["__dropped__"] = (z["__dropped__"]
-                                      if "__dropped__" in z.files
-                                      else np.frombuffer(b"[]", np.uint8))
-            step = int(z["__step__"])
-            pub_ts = float(z["__pub_ts__"])
+        try:
+            with np.load(io.BytesIO(payload)) as z:
+                meta_gen = int(z["__generation__"])
+                version = int(z["__version__"])
+                kind = int(z["__kind__"])
+                base_version = int(z["__base_version__"])
+                tid = int(z["__trace__"])
+                entries = {k: z[k] for k in z.files if not k.startswith("__")}
+                entries["__same__"] = (z["__same__"] if "__same__" in z.files
+                                       else np.frombuffer(b"[]", np.uint8))
+                entries["__dropped__"] = (z["__dropped__"]
+                                          if "__dropped__" in z.files
+                                          else np.frombuffer(b"[]", np.uint8))
+                step = int(z["__step__"])
+                pub_ts = float(z["__pub_ts__"])
+        except (ValueError, KeyError, OSError, zipfile.BadZipFile) as e:
+            # crc-valid but unparseable body (the sender corrupted it
+            # BEFORE checksumming, or a hostile peer checksummed
+            # garbage): detected, counted, never adopted. Raise
+            # ConnectionError so get_if_newer degrades to stale weights
+            # exactly like a torn frame instead of crashing the actor.
+            self.counters["torn_rejected"] += 1
+            record_event("weight_torn_rejected",
+                         addr=f"{self._addr[0]}:{self._addr[1]}",
+                         bytes=len(payload), parse_error=type(e).__name__)
+            raise ConnectionError(
+                f"weight frame unparseable after crc pass: {e}") from e
         if meta_gen < self.generation:
             # generation fence: a pre-crash frame can NEVER be adopted,
             # whatever its version number claims

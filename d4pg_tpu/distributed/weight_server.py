@@ -24,19 +24,22 @@ from __future__ import annotations
 
 import io
 import socket
-import struct
 import threading
 import time
+import zipfile
 
 import numpy as np
 
 from d4pg_tpu.core.locking import TieredLock
+# Frame shapes come from the declared wire registry (weights-v1 rows);
+# see core/wire.py and ``python -m d4pg_tpu.lint --wire``.
+from d4pg_tpu.core.wire import (
+    MAGIC_WEIGHTS_V1 as _MAGIC,
+    WEIGHTS_V1_REQ as _REQ,
+    WEIGHTS_V1_RESP as _RESP,
+)
 from d4pg_tpu.distributed.weights import WeightStore
 from d4pg_tpu.obs.flight import record_event
-
-_MAGIC = 0xD4F7
-_REQ = struct.Struct("!Iq")
-_RESP = struct.Struct("!II")
 
 
 def _flatten(params) -> dict[str, np.ndarray]:
@@ -272,14 +275,30 @@ class WeightClient(ReconnectingClient):
                 return None  # act on stale weights; retry next pull
         if payload is None:
             return None
-        with np.load(io.BytesIO(payload)) as z:
-            flat = {k: z[k] for k in z.files if not k.startswith("__")}
-            version = int(z["__version__"])
-            self.step = int(z["__step__"])
-            if "__norm_mean__" in z.files:
-                self.norm_stats = (z["__norm_mean__"], z["__norm_std__"])
-                if "__norm_clip__" in z.files:
-                    self.norm_stats += (float(z["__norm_clip__"]),)
+        try:
+            with np.load(io.BytesIO(payload)) as z:
+                flat = {k: z[k] for k in z.files if not k.startswith("__")}
+                version = int(z["__version__"])
+                step = int(z["__step__"])
+                norm: tuple | None = None
+                if "__norm_mean__" in z.files:
+                    norm = (z["__norm_mean__"], z["__norm_std__"])
+                    if "__norm_clip__" in z.files:
+                        norm += (float(z["__norm_clip__"]),)
+        except (ValueError, KeyError, OSError, zipfile.BadZipFile) as e:
+            # hostile-but-well-framed body (garbage npz bytes, missing
+            # __version__/__step__ members): a deterministic protocol
+            # fault, not downtime — drop the socket so the next pull
+            # reconnects instead of reading a desynced stream, and
+            # surface it like every other wire-format violation.
+            with self._lock:
+                self._drop_sock()
+            raise ProtocolError(f"corrupt weight payload: {e}") from e
+        # commit only after the whole body parsed: a torn parse must not
+        # leave self.step ahead of the weights the actor is acting on
+        self.step = step
+        if norm is not None:
+            self.norm_stats = norm
         return version, _unflatten(flat)
 
     def _pull(self, have_version: int) -> bytes | None:

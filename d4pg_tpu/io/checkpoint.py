@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import os
 import pickle
-import struct
 import zlib
 from typing import Any
 
@@ -20,6 +19,13 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+# Sidecar frame shape comes from the declared wire registry; see
+# core/wire.py and ``python -m d4pg_tpu.lint --wire``.
+from d4pg_tpu.core.wire import (
+    SIDECAR_HEAD as _SIDECAR_HEAD,
+    SIDECAR_MAGIC as _SIDECAR_MAGIC,
+    SIDECAR_VERSION,
+)
 from d4pg_tpu.learner.state import D4PGState
 
 # -- replay sidecar (crash-recovery plane) ---------------------------------
@@ -31,10 +37,6 @@ from d4pg_tpu.learner.state import D4PGState
 # rot is REJECTED with a clean error instead of feeding a half-snapshot
 # into load_state_dict (where it would surface as a shape error deep in
 # the buffer, or worse, not at all).
-
-_SIDECAR_MAGIC = b"D4RS"  # D4PG Replay Sidecar
-_SIDECAR_HEAD = struct.Struct("!4sBI")  # magic, version, crc32(payload)
-SIDECAR_VERSION = 1
 
 
 class SnapshotCorruptError(RuntimeError):

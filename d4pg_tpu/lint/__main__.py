@@ -6,6 +6,10 @@ With no paths, lints the ``d4pg_tpu`` package itself.
 ``--locks`` prints the discovered whole-program lock graph (nodes, edges
 with witness sites, cycles) instead of findings — the review artifact
 for concurrency-touching PRs; exit 1 iff the graph has a cycle.
+
+``--wire`` prints the discovered wire-protocol registry (magics, owning
+planes, pack/unpack witness sites, flag-bit map) — the review artifact
+for protocol-touching PRs; exit 1 iff any wire family fires.
 """
 
 from __future__ import annotations
@@ -14,7 +18,7 @@ import argparse
 import os
 import sys
 
-from d4pg_tpu.lint.engine import build_lock_graph, lint_paths
+from d4pg_tpu.lint.engine import build_lock_graph, build_wire_graph, lint_paths
 from d4pg_tpu.lint.rules import RULES
 
 
@@ -35,6 +39,11 @@ def main(argv: list[str] | None = None) -> int:
                         help="print the whole-program lock graph (nodes, "
                              "edges, cycles) instead of findings; exit 1 "
                              "iff a cycle exists")
+    parser.add_argument("--wire", action="store_true",
+                        help="print the discovered wire-protocol registry "
+                             "(magics, pack/unpack witnesses, flag bits) "
+                             "instead of findings; exit 1 iff any wire "
+                             "family fires")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -52,6 +61,17 @@ def main(argv: list[str] | None = None) -> int:
         for e in errors:
             print(e, file=sys.stderr)
         return 1 if graph.cycles else 0
+
+    if args.wire:
+        from d4pg_tpu.lint.wiregraph import format_registry
+
+        paths = args.paths or [os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))]
+        graph, errors = build_wire_graph(paths)
+        print(format_registry(graph))
+        for e in errors:
+            print(e, file=sys.stderr)
+        return 1 if graph.findings else 0
 
     rules = None
     if args.rules:

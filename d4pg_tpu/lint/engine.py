@@ -2,10 +2,13 @@
 
 Two rule scopes (``rules.Rule.scope``): *module* rules run per file, the
 *program* families (the interprocedural lock graph — ``lock-cycle``,
-``unguarded-shared-write``) run ONCE over every parsed module of the
+``unguarded-shared-write`` — and the wire-protocol registry —
+``wire-magic-registry``, ``codec-asymmetry``, ``unchecked-frame``,
+``flag-bit-collision``) run ONCE over every parsed module of the
 invocation so cross-module call edges (``replay_service`` into
-``staging``) exist. ``lint_source`` treats its single module as a whole
-program, which is what the fixture tests drive.
+``staging``) and import chains (plane modules into ``core/wire.py``)
+exist. ``lint_source`` treats its single module as a whole program,
+which is what the fixture tests drive.
 """
 
 from __future__ import annotations
@@ -66,11 +69,21 @@ def _run_program_rules(ctxs: list[ModuleContext], program_ids: list[str],
                        result: LintResult) -> None:
     if not program_ids or not ctxs:
         return
-    from d4pg_tpu.lint import lockgraph
+    from d4pg_tpu.lint.wiregraph import WIRE_RULES
 
+    lock_ids = [r for r in program_ids if r not in WIRE_RULES]
+    wire_ids = [r for r in program_ids if r in WIRE_RULES]
     per_file: dict[str, list[Finding]] = {}
-    for f in lockgraph.analyze(ctxs, rules=program_ids).findings:
-        per_file.setdefault(f.file, []).append(f)
+    if lock_ids:
+        from d4pg_tpu.lint import lockgraph
+
+        for f in lockgraph.analyze(ctxs, rules=lock_ids).findings:
+            per_file.setdefault(f.file, []).append(f)
+    if wire_ids:
+        from d4pg_tpu.lint import wiregraph
+
+        for f in wiregraph.analyze(ctxs, rules=wire_ids).findings:
+            per_file.setdefault(f.file, []).append(f)
     for path, found in sorted(per_file.items()):
         _sift(found, sups.get(path, Suppressions()), result)
 
@@ -138,4 +151,23 @@ def build_lock_graph(paths: list[str]):
         except (OSError, SyntaxError) as e:
             errors.append(f"{path}: {e}")
     graph = lockgraph.analyze(ctxs)
+    return graph, errors
+
+
+def build_wire_graph(paths: list[str]):
+    """The ``--wire`` review artifact: the discovered wire-protocol
+    registry over ``paths`` (magics, owners, pack/unpack witnesses,
+    flag-bit map, findings)."""
+    from d4pg_tpu.lint import wiregraph
+
+    ctxs: list[ModuleContext] = []
+    errors: list[str] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            ctxs.append(build_context(path, source))
+        except (OSError, SyntaxError) as e:
+            errors.append(f"{path}: {e}")
+    graph = wiregraph.analyze(ctxs)
     return graph, errors

@@ -1,6 +1,6 @@
 """The jaxlint rule catalog.
 
-Ten rule families, each targeting a hazard that silently costs
+Fourteen rule families, each targeting a hazard that silently costs
 throughput or correctness on this stack (see docs/architecture.md "Static
 analysis & perf sentinels" for the rationale and suppression policy):
 
@@ -14,10 +14,15 @@ analysis & perf sentinels" for the rationale and suppression policy):
 - ``lock-order``           — service/buffer lock acquired under a shard lock
 - ``lock-cycle``           — interprocedural ABBA cycle in the lock graph
 - ``unguarded-shared-write`` — shared attribute mutated off its owning lock
+- ``wire-magic-registry``  — frame magic/flag bit outside the declared table
+- ``codec-asymmetry``      — pack/unpack format or field-count drift
+- ``unchecked-frame``      — recv-rooted decode without error/crc containment
+- ``flag-bit-collision``   — one flag-byte bit claimed by two extensions
 
-The last two are PROGRAM-scope families implemented in
-``lint/lockgraph.py``: they analyze every module of a lint run together
-(cross-module call graph), where everything above is per-module.
+The last six are PROGRAM-scope families implemented in
+``lint/lockgraph.py`` (locks) and ``lint/wiregraph.py`` (wire protocol):
+they analyze every module of a lint run together (cross-module call
+graph), where everything above is per-module.
 
 Every rule is a function ``(ModuleContext) -> list[Finding]`` registered in
 ``RULES``. Rules are deliberately conservative: a finding should be either
@@ -785,6 +790,17 @@ def _program_rule(rule_id: str):
     return check
 
 
+def _wire_rule(rule_id: str):
+    """Same single-module fallback for the wire-protocol families
+    (``lint/wiregraph.py``)."""
+    def check(ctx: ModuleContext) -> list[Finding]:
+        from d4pg_tpu.lint import wiregraph
+
+        return wiregraph.analyze([ctx], rules=[rule_id]).findings
+
+    return check
+
+
 RULES: dict[str, Rule] = {r.id: r for r in [
     Rule("prng-key-reuse",
          "same PRNG key consumed by two jax.random samplers without an "
@@ -826,4 +842,23 @@ RULES: dict[str, Rule] = {r.id: r for r in [
          "attribute written without the lock every other access holds "
          "(ownership inferred; declare `# jaxlint: guarded-by=<lock>`)",
          _program_rule("unguarded-shared-write"), scope="program"),
+    Rule("wire-magic-registry",
+         "0xD4xx magic or flag bit packed into a frame but absent from / "
+         "re-declared outside the declared registry (core/wire.py); "
+         "seed-derivation literals are exempt",
+         _wire_rule("wire-magic-registry"), scope="program"),
+    Rule("codec-asymmetry",
+         "pack/unpack format not a field segment of its magic's declared "
+         "header, arg/target count drift, *_SIZE constant != calcsize, or "
+         "a magic packed but never unpacked",
+         _wire_rule("codec-asymmetry"), scope="program"),
+    Rule("unchecked-frame",
+         "socket-facing decode (recv -> unpack/np.load/np.frombuffer) "
+         "without struct.error/ValueError containment, or payload use "
+         "before the declared crc32 check",
+         _wire_rule("unchecked-frame"), scope="program"),
+    Rule("flag-bit-collision",
+         "two extensions claiming the same bit of the same plane's flag "
+         "byte — see core/wire.py for the allocations",
+         _wire_rule("flag-bit-collision"), scope="program"),
 ]}

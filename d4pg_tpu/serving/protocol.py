@@ -25,10 +25,26 @@ pair so a lane can observe exactly which fenced snapshot acted for it.
 
 from __future__ import annotations
 
-import struct
 import zlib
 
 import numpy as np
+
+# Frame shapes come from the declared wire registry (serve-request /
+# serve-response rows); see core/wire.py and
+# ``python -m d4pg_tpu.lint --wire``. MAX_BODY is the serving plane's
+# tighter cap (requests/responses are tiny next to the transport
+# plane's 64 MiB bound; it catches a desynced stream before it
+# allocates gigabytes).
+from d4pg_tpu.core.wire import (
+    FRAME_HEADER as HEADER,
+    MAGIC_SERVE_REQUEST as MAGIC_REQUEST,
+    MAGIC_SERVE_RESPONSE as MAGIC_RESPONSE,
+    MAX_BODY,
+    SERVE_REQ_HEADER as REQ_HEADER,
+    SERVE_RSP_HEADER as RSP_HEADER,
+    SERVE_TRACE_EXT as TRACE_EXT,
+    SFLAG_TRACE as FLAG_TRACE,
+)
 
 
 class ProtocolError(RuntimeError):
@@ -40,25 +56,9 @@ class ProtocolError(RuntimeError):
     that speak both planes catch both types explicitly."""
 
 
-MAGIC_REQUEST = 0xD4E2
-MAGIC_RESPONSE = 0xD4E3
-
-# Outer frame header, shared with every other plane: (magic, body_len).
-HEADER = struct.Struct("!II")
-REQ_HEADER = struct.Struct("!BIHHI")
-RSP_HEADER = struct.Struct("!BIIIHHI")
-TRACE_EXT = struct.Struct("!Qd")
-
-FLAG_TRACE = 0x01
-
 STATUS_OK = 0
 STATUS_NO_PARAMS = 1
 STATUS_BAD_REQUEST = 2
-
-# Requests are obs batches, responses action batches — both tiny next to
-# the transport plane's 64 MiB bound; a tighter cap catches a desynced
-# stream before it allocates gigabytes.
-MAX_BODY = 8 << 20
 
 
 class TornFrameError(ProtocolError):

@@ -303,11 +303,16 @@ def test_rule_catalog_covers_all_families():
         "prng-key-reuse", "host-sync-in-jit", "recompile-hazard",
         "use-after-donation", "tracer-leak", "device-put-in-loop",
         "host-time-in-jit", "lock-order", "lock-cycle",
-        "unguarded-shared-write",
+        "unguarded-shared-write", "wire-magic-registry",
+        "codec-asymmetry", "unchecked-frame", "flag-bit-collision",
     }
-    # the lock-graph families analyze whole programs, not single modules
+    # the lock-graph and wire-graph families analyze whole programs,
+    # not single modules
     assert RULES["lock-cycle"].scope == "program"
     assert RULES["unguarded-shared-write"].scope == "program"
+    for rule in ("wire-magic-registry", "codec-asymmetry",
+                 "unchecked-frame", "flag-bit-collision"):
+        assert RULES[rule].scope == "program"
     assert RULES["lock-order"].scope == "module"
 
 
@@ -739,3 +744,291 @@ def test_lock_graph_cli_mode(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "cycles: none" in out
     assert "_buffer_lock -> _ring_locks" in out
+
+
+# ------------------------------------------------- wire families (11-14) --
+
+def test_wire_magic_registry_fires_on_unregistered_magic():
+    out = findings("""
+        import struct
+
+        def encode(payload):
+            return struct.pack("!HI", 0xD412, len(payload)) + payload
+        """, "wire-magic-registry")
+    assert len(out) == 1
+    assert "0xD412" in out[0].message and "absent" in out[0].message
+
+
+def test_wire_magic_registry_fires_on_private_redeclare():
+    out = findings("""
+        import struct
+
+        _MAGIC = 0xD4F6  # privately re-declares the ingest-v1 magic
+
+        def encode(payload):
+            return struct.pack("!II", _MAGIC, len(payload)) + payload
+        """, "wire-magic-registry")
+    assert len(out) == 1
+    assert "re-declares" in out[0].message
+    assert "d4pg_tpu.core.wire" in out[0].message
+
+
+def test_wire_magic_registry_exempts_seed_literals():
+    out = findings("""
+        import numpy as np
+
+        def rng(seed, replica):
+            ss = np.random.SeedSequence(seed, spawn_key=(0xD4E4, replica))
+            return np.random.default_rng(seed ^ 0xD4E3)
+        """, "wire-magic-registry")
+    assert out == []
+
+
+def test_wire_magic_registry_fires_on_undeclared_flag_bit():
+    out = findings("""
+        import struct
+
+        SFLAG_PRIORITY = 0x08  # bit never allocated in the registry
+
+        def check(magic):
+            return magic == 0xD4E2
+        """, "wire-magic-registry")
+    assert len(out) == 1
+    assert "flag bit 0x08" in out[0].message
+
+
+def test_codec_asymmetry_fires_on_format_drift():
+    # decoder reads three fields where the ingest header declares two
+    out = findings("""
+        import struct
+
+        def decode(head):
+            if not head:
+                return None
+            try:
+                got, length, extra = struct.unpack("!IIH", head)
+            except struct.error:
+                return None
+            return got == 0xD4F6
+        """, "codec-asymmetry")
+    assert len(out) == 1
+    assert "'!IIH'" in out[0].message and "segment" in out[0].message
+
+
+def test_codec_asymmetry_fires_on_size_const_drift():
+    out = findings("""
+        import struct
+
+        HDR = struct.Struct("!II")
+        HDR_SIZE = 12  # calcsize says 8
+        """, "codec-asymmetry")
+    assert len(out) == 1
+    assert "HDR_SIZE = 12" in out[0].message and "= 8" in out[0].message
+
+
+def test_codec_asymmetry_fires_on_argument_count_drift():
+    out = findings("""
+        import struct
+
+        def greet(gen, extra):
+            return struct.pack("!HI", 0xD4FA, gen, extra)
+        """, "codec-asymmetry")
+    drift = [f for f in out if "2 field(s)" in f.message]
+    assert len(drift) == 1
+    assert "3 argument(s)" in drift[0].message
+
+
+def test_codec_asymmetry_fires_on_one_sided_magic():
+    out = findings("""
+        import struct
+
+        def greet(gen):
+            return struct.pack("!HI", 0xD4FA, gen)
+        """, "codec-asymmetry")
+    assert len(out) == 1
+    assert "one-sided" in out[0].message
+
+
+def test_codec_asymmetry_clean_on_split_reads():
+    # weight_plane's idiom: magic read separately, then the remainder of
+    # the declared request format — both are contiguous field segments
+    out = findings("""
+        import struct
+
+        _REQ = struct.Struct("!IqIBB")
+
+        def serve(conn, recv_exact):
+            head = recv_exact(conn, 4)
+            if head is None:
+                return None
+            (magic,) = struct.unpack("!I", head)
+            if magic != 0xD4FC:
+                return None
+            rest = recv_exact(conn, _REQ.size - 4)
+            have, gen, codec, flags = struct.unpack("!qIBB", rest)
+            return have, gen, codec, flags
+        """, "codec-asymmetry")
+    assert out == []
+
+
+def test_unchecked_frame_fires_on_naked_recv_unpack():
+    out = findings("""
+        import struct
+
+        def serve(sock):
+            head = sock.recv(64)
+            magic, length = struct.unpack("!II", head)
+            return sock.recv(length)
+        """, "unchecked-frame")
+    assert len(out) == 1
+    assert "struct.error containment" in out[0].message
+
+
+def test_unchecked_frame_clean_on_contained_or_exact_read():
+    out = findings("""
+        import struct
+
+        HDR = struct.Struct("!II")
+
+        def serve_contained(sock):
+            head = sock.recv(64)
+            try:
+                magic, length = struct.unpack("!II", head)
+            except struct.error:
+                return None
+            return magic, length
+
+        def serve_exact(sock):
+            head = sock.recv(HDR.size)
+            magic, length = HDR.unpack(head)
+            return magic, length
+        """, "unchecked-frame")
+    assert out == []
+
+
+def test_unchecked_frame_fires_on_parse_before_crc():
+    # weights-v2 declares crc32-payload: np.load before any crc32 call
+    # on the path is a torn-frame acceptance hazard even when contained
+    out = findings("""
+        import io
+        import struct
+
+        import numpy as np
+
+        def pull(sock):
+            head = sock.recv(13)
+            magic, kind, crc, length = struct.unpack("!IBII", head)
+            if magic != 0xD4FC:
+                return None
+            payload = sock.recv(length)
+            try:
+                with np.load(io.BytesIO(payload)) as z:
+                    return dict(z)
+            except ValueError:
+                return None
+        """, "unchecked-frame")
+    assert len(out) == 1
+    assert "crc32" in out[0].message
+
+
+def test_unchecked_frame_clean_when_crc_checked_first():
+    out = findings("""
+        import io
+        import struct
+        import zlib
+
+        import numpy as np
+
+        def pull(sock):
+            head = sock.recv(13)
+            magic, kind, crc, length = struct.unpack("!IBII", head)
+            if magic != 0xD4FC:
+                return None
+            payload = sock.recv(length)
+            if zlib.crc32(payload) != crc:
+                return None
+            try:
+                with np.load(io.BytesIO(payload)) as z:
+                    return dict(z)
+            except ValueError:
+                return None
+        """, "unchecked-frame")
+    assert out == []
+
+
+def test_flag_bit_collision_fires_on_registry_conflict():
+    out = findings("""
+        import struct
+
+        F_TENANT = 0x01  # bit 0 of the serving flag byte is 'trace'
+
+        def check(magic):
+            return magic == 0xD4E2
+        """, "flag-bit-collision")
+    assert len(out) == 1
+    assert "already allocated to 'trace'" in out[0].message
+
+
+def test_flag_bit_collision_fires_on_two_local_claims():
+    out = findings("""
+        import struct
+
+        F_AAA = 0x08
+        FLAG_BBB = 0x08  # same undeclared bit, different meaning
+
+        def check(magic):
+            return magic == 0xD4E2
+        """, "flag-bit-collision")
+    assert len(out) == 1
+    assert "FLAG_BBB" in out[0].message and "F_AAA" in out[0].message
+
+
+def test_flag_bit_collision_clean_on_consistent_mirror():
+    # a local alias of a declared bit with a matching meaning is the
+    # sanctioned pattern (transport's _F_TRACE before the registry)
+    out = findings("""
+        import struct
+
+        _F_TRACE = 0x02
+
+        def check(magic):
+            return magic == 0xD4F8
+        """, "flag-bit-collision")
+    assert out == []
+
+
+def test_wire_cli_mode(tmp_path, capsys):
+    """`--wire` prints the registry artifact; exit 1 iff a family fires."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import struct
+
+        def encode(payload):
+            return struct.pack("!HI", 0xD412, len(payload)) + payload
+        """))
+    assert lint_main(["--wire", str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "0xD412" in out and "findings:" in out
+
+    good = tmp_path / "good.py"
+    good.write_text(textwrap.dedent("""
+        import struct
+
+        HDR = struct.Struct("!II")
+
+        def greet(sock, gen):
+            sock.sendall(struct.pack("!HI", 0xD4FA, gen))
+
+        def read_greeting(sock):
+            head = sock.recv(6)
+            try:
+                magic, gen = struct.unpack("!HI", head)
+            except struct.error:
+                return None
+            if magic != 0xD4FA:
+                return None
+            return gen
+        """))
+    assert lint_main(["--wire", str(good)]) == 0
+    out = capsys.readouterr().out
+    assert "0xD4FA" in out and "findings: none" in out

@@ -166,3 +166,69 @@ def test_cli_module_entrypoint():
         [sys.executable, "-m", "d4pg_tpu.lint", PACKAGE_DIR],
         capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.lint
+def test_wire_graph_clean_over_package():
+    """Tier-1 gate for the protocol surface: the whole-program wire graph
+    over ``d4pg_tpu/`` must discover every declared magic with at least
+    one pack AND one unpack witness, reproduce the declared flag-bit
+    map, and carry zero findings."""
+    from d4pg_tpu.lint.engine import build_wire_graph
+    from d4pg_tpu.lint.wiregraph import format_registry
+
+    graph, errors = build_wire_graph([PACKAGE_DIR])
+    assert not errors, errors
+    assert graph.findings == [], format_registry(graph)
+    from d4pg_tpu.core import wire
+
+    declared_magics = {spec.magic for spec in wire.REGISTRY.values()}
+    assert set(graph.magics) == declared_magics, format_registry(graph)
+    for magic, e in graph.magics.items():
+        assert e["packs"], f"{magic!r}: no pack witness discovered"
+        assert e["unpacks"], f"{magic!r}: no unpack witness discovered"
+        assert e["plane"] is not None
+    # the discovered flag map IS the declared per-plane allocation
+    for plane, bits in wire.PLANE_FLAG_BITS.items():
+        if bits:
+            assert graph.flags.get(plane) == dict(bits), (plane, graph.flags)
+        else:
+            assert not graph.flags.get(plane), (plane, graph.flags)
+
+
+@pytest.mark.lint
+def test_wire_mirror_matches_declared_registry():
+    """The lint package is stdlib-only, so ``wiregraph._DECLARED``
+    mirrors ``core.wire.REGISTRY`` instead of importing it. This pin is
+    what makes the mirror safe: any drift — a row added, a format
+    changed, a flag reallocated, a crc discipline flipped — fails here
+    with the exact rows named."""
+    from d4pg_tpu.core import wire
+    from d4pg_tpu.lint.wiregraph import _DECLARED
+
+    declared = {
+        name: (spec.plane, spec.magic, spec.header, spec.crc,
+               tuple(sorted(spec.flags)),
+               tuple(fmt for _ext_name, fmt in spec.extensions))
+        for name, spec in wire.REGISTRY.items()}
+    mirrored = {
+        row[0]: (row[1], row[2], row[3], row[4],
+                 tuple(sorted(row[5])), tuple(row[6]))
+        for row in _DECLARED}
+    assert mirrored == declared
+
+
+@pytest.mark.lint
+def test_cli_wire_mode_clean():
+    """``python -m d4pg_tpu.lint --wire`` is the review artifact for
+    protocol PRs; it must exit 0 on the repo, print every declared
+    magic, and report no findings."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "d4pg_tpu.lint", "--wire", PACKAGE_DIR],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "findings: none" in proc.stdout
+    for magic in ("0xD4AB", "0xD4E2", "0xD4E3", "0xD4F6", "0xD4F7",
+                  "0xD4F8", "0xD4FA", "0xD4FC", "D4RS"):
+        assert magic in proc.stdout, proc.stdout
+    assert "flag bits:" in proc.stdout
