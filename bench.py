@@ -510,7 +510,41 @@ def bench_fleet(ns=(8, 32, 64, 128, 256), duration_s: float = 10.0,
     artifact["sampler"] = run_sampler(
         n_actors=max(64, min(ns)), duration_s=min(duration_s, 6.0),
         seed=seed, learner_kills=2, stale_frames=8)
+    # mesh-learners block: the socket-vs-collective aggregation A/B at
+    # equal offered load (fleet/mesh_ab.py) — updates/s each arm and
+    # per-round aggregation latency p50/p95 per replica count. The only
+    # fleet block that needs a JAX backend, so it runs in a child
+    # process with virtual devices; this parent stays accelerator-free.
+    # Schema-checked in tier-1 (tests/test_mesh_replicas.py).
+    artifact["mesh_learners"] = _run_mesh_learners_child(seed)
     return artifact
+
+
+def _run_mesh_learners_child(seed: int) -> dict:
+    """Run the mesh_learners A/B in a child with 8 virtual CPU devices
+    (the fleet parent keeps JAX uninitialized by design). A failed child
+    returns an error stub instead of sinking the whole artifact — the
+    schema gate on the committed artifact still catches it."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["D4PG_BENCH_MESH_CHILD"] = "1"
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count=8".strip())
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--mesh-learners",
+             f"--seed={seed}"],
+            env=env, capture_output=True, text=True, timeout=1800)
+    except subprocess.TimeoutExpired:
+        return {"metric": "fleet_mesh_learners", "schema": 1,
+                "error": "child timed out"}
+    if proc.returncode != 0:
+        return {"metric": "fleet_mesh_learners", "schema": 1,
+                "error": (proc.stderr or proc.stdout)[-2000:]}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
 def bench_projection_variants(k: int = 40, steps: int = 1600) -> dict | None:
@@ -752,6 +786,34 @@ def bench_sharded_overhead(shard_counts=(1, 2, 4, 8), k: int = 8,
 
 
 def main():
+    if "--mesh-learners" in sys.argv:
+        # needs its own process like --sharded-overhead: the virtual
+        # device count must be fixed BEFORE backend init
+        if os.environ.get("D4PG_BENCH_MESH_CHILD") != "1":
+            import subprocess
+
+            env = dict(os.environ)
+            env["D4PG_BENCH_MESH_CHILD"] = "1"
+            flags = env.get("XLA_FLAGS", "")
+            if "host_platform_device_count" not in flags:
+                env["XLA_FLAGS"] = (
+                    f"{flags} --xla_force_host_platform_device_count=8".strip()
+                )
+            raise SystemExit(subprocess.call(
+                [sys.executable, os.path.abspath(__file__)]
+                + [a for a in sys.argv[1:]], env=env,
+            ))
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from d4pg_tpu.fleet.sweep import run_mesh_learners
+
+        seed = 0
+        for a in sys.argv[1:]:
+            if a.startswith("--seed="):
+                seed = int(a.split("=", 1)[1])
+        print(json.dumps(run_mesh_learners(seed=seed)))
+        return
     if "--fleet" in sys.argv:
         # host+TCP only — keep jax/accelerator entirely out of the picture
         # (256 sender threads + a receiver need the core, not a backend)

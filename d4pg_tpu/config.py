@@ -104,6 +104,14 @@ class ExperimentConfig:
     # max(1/(1+lag), 1/agg_clip) — the floor keeps a lagging replica's
     # vote bounded away from zero (>= 1; higher tolerates more staleness)
     agg_clip: float = 8.0
+    # How replica updates reach the merge (learner/mesh_replicas.py):
+    # 'collective' = mesh-native — replica states sharded along the
+    # 'replica' mesh axis, the merge an on-device collective (requires
+    # the replicas to share one single-host mesh); 'socket' = the PR-10
+    # host-thread aggregator over 0xD4AB frames (works anywhere; the
+    # cross-host fallback); 'auto' = collective when a mesh is present
+    # and single-host, socket otherwise.
+    agg_transport: str = "auto"
     # algorithm
     gamma: float = 0.99  # --gamma
     tau: float = 0.001  # --tau
@@ -478,6 +486,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--agg_clip", type=float, default=d.agg_clip,
                    help="staleness-weight clip (async mode): a stale "
                         "update's weight is max(1/(1+lag), 1/clip)")
+    p.add_argument("--agg_transport", choices=("auto", "socket", "collective"),
+                   default=d.agg_transport,
+                   help="how replica updates reach the merge: "
+                        "'collective' = mesh-native on-device merge over "
+                        "the 'replica' mesh axis (replicas share one "
+                        "single-host mesh), 'socket' = host-thread "
+                        "aggregator over 0xD4AB frames (cross-host "
+                        "fallback), 'auto' = collective when a mesh is "
+                        "present and single-host")
     _add_bool_flag(p, "sample_on_ingest", d.sample_on_ingest,
                    "fuse PER sampling into the receive path: the commit "
                    "thread deals ready-to-train blocks to the learner "

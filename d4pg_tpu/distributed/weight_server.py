@@ -43,17 +43,18 @@ from d4pg_tpu.obs.flight import record_event
 
 
 def _flatten(params) -> dict[str, np.ndarray]:
-    """Flatten a nested dict pytree to {'a/b/c': array} (flax's own
-    param-dict flattening, so key semantics match Flax exactly)."""
-    from flax.traverse_util import flatten_dict
+    """Flatten a nested dict pytree to {'a/b/c': array}. Delegates to
+    ``partition.named_flat`` — the wire keys ARE the partition-rule key
+    grammar, so the sharding table and the weight codec cannot drift."""
+    from d4pg_tpu.parallel.partition import named_flat
 
-    return {k: np.asarray(v) for k, v in flatten_dict(params, sep="/").items()}
+    return named_flat(params)
 
 
 def _unflatten(flat: dict[str, np.ndarray]):
-    from flax.traverse_util import unflatten_dict
+    from d4pg_tpu.parallel.partition import named_unflat
 
-    return unflatten_dict(dict(flat), sep="/")
+    return named_unflat(flat)
 
 
 from d4pg_tpu.distributed.transport import (

@@ -1,0 +1,250 @@
+"""Socket-vs-collective aggregation A/B: the ``mesh_learners`` block.
+
+Both arms run the SAME offered load — N replicas with identical nets
+from decorrelated seeds, identically-filled fused device rings, R
+timed rounds of S fused grad steps per replica at the same (k, batch)
+— and differ ONLY in how a round's updates become the next round's
+basis:
+
+- **socket** arm: the PR-10 host-thread plane (``--agg_transport
+  socket``). Each replica thread trains through the legacy
+  ``FusedLoop`` and then pays the full host round trip per round:
+  device→host pull of all four param subtrees (``params_of``), the
+  aggregator's host-numpy merge math, and the host→device push when it
+  adopts the next basis (``adopt_params``).
+- **collective** arm: ``MeshReplicaGroup`` (``--agg_transport
+  collective``). Replica states are [N, ...]-stacked along the
+  ``replica`` mesh axis by partition rule, the SAME pure fused chunk
+  runs under ``shard_map``, and the merge + basis adoption is one
+  on-device computation — the params never visit the host.
+
+Per-round aggregation latency (p50/p95 across timed rounds) is the
+attribution headline: grad work is identical by construction, so the
+arms differ exactly by the transport the tentpole replaces. One warmup
+round per arm absorbs jit compilation before timing starts.
+
+On CPU the collective arm runs over virtual devices
+(``xla_force_host_platform_device_count``), which prices dispatch
+structure and collective count honestly but NOT real ICI bandwidth —
+the artifact labels the backend for that reason.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+
+from d4pg_tpu.obs.registry import percentile_summary
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshABConfig:
+    """One socket-vs-collective pair at ``n_replicas``. ``(config,
+    seed)`` fixes the fills, the initial states and the sampling
+    streams, so the two arms train on identical work."""
+
+    n_replicas: int = 2
+    rounds: int = 6          # timed rounds (one extra warmup round each)
+    steps_per_round: int = 8
+    k: int = 4
+    batch_size: int = 32
+    n_rows: int = 512
+    obs_dim: int = 8
+    act_dim: int = 2
+    hidden: tuple = (32, 32)
+    mode: str = "async"
+    clip: float = 8.0
+    seed: int = 0
+
+
+def _learner_config(cfg: MeshABConfig):
+    from d4pg_tpu.learner import D4PGConfig
+
+    return D4PGConfig(obs_dim=cfg.obs_dim, act_dim=cfg.act_dim,
+                      v_min=-10.0, v_max=10.0, n_atoms=51,
+                      hidden=tuple(cfg.hidden))
+
+
+def _fill(cfg: MeshABConfig):
+    """A deterministically-filled fused device ring (one per replica
+    per arm — the fused engine's ring is single-consumer)."""
+    from d4pg_tpu.replay.fused_buffer import FusedDeviceReplay
+    from d4pg_tpu.replay.uniform import TransitionBatch
+
+    rng = np.random.default_rng(cfg.seed)
+    n = cfg.n_rows
+    batch = TransitionBatch(
+        obs=rng.standard_normal((n, cfg.obs_dim)).astype(np.float32),
+        action=rng.uniform(-1, 1, (n, cfg.act_dim)).astype(np.float32),
+        reward=rng.standard_normal(n).astype(np.float32),
+        next_obs=rng.standard_normal((n, cfg.obs_dim)).astype(np.float32),
+        done=np.zeros(n, np.float32),
+        discount=np.full(n, 0.99, np.float32))
+    buf = FusedDeviceReplay(n, cfg.obs_dim, cfg.act_dim, alpha=0.6)
+    buf.add(batch)
+    buf.drain()
+    return buf
+
+
+def _replica_states(config, n: int):
+    """train.py's replica construction: identical nets, decorrelated
+    keys, per-replica leaf copies (updates donate their inputs)."""
+    import jax
+    import jax.numpy as jnp
+
+    from d4pg_tpu.learner import init_state
+
+    base = init_state(config, jax.random.key(0))
+    states = []
+    for i in range(n):
+        rstate = jax.tree_util.tree_map(jnp.copy, base)
+        if i:
+            rstate = rstate._replace(key=jax.random.fold_in(rstate.key, i))
+        states.append(rstate)
+    return states
+
+
+def _run_socket_arm(cfg: MeshABConfig, config) -> dict:
+    """N host-thread replicas through the in-process ``Aggregator`` —
+    train.py's socket-transport wiring, minus the TCP hop (which only
+    exists cross-host; within a host the D2H/H2D crossings and the
+    host merge math ARE the transport cost)."""
+    import jax
+
+    from d4pg_tpu.distributed.weights import WeightStore
+    from d4pg_tpu.learner.aggregator import Aggregator
+    from d4pg_tpu.learner.loop import FusedLoop
+    from d4pg_tpu.learner.replica import adopt_params, params_of
+
+    n = cfg.n_replicas
+    agg = Aggregator(WeightStore(), mode=cfg.mode, clip=cfg.clip)
+    states = _replica_states(config, n)
+    loops = [FusedLoop(config, _fill(cfg), k=cfg.k,
+                       batch_size=cfg.batch_size) for _ in range(n)]
+    epochs = [agg.register(i) for i in range(n)]
+    bvs = [0] * n  # each replica's last-pulled basis version
+    agg_lat: list[float] = []
+
+    def _fanout(fn) -> None:
+        threads = [threading.Thread(target=fn, args=(i,), daemon=True)
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def round_once(timed: bool) -> None:
+        # grad phase: every replica trains S steps on its own thread
+        def grads(i: int) -> None:
+            states[i], _ = loops[i].run(states[i], cfg.steps_per_round)
+
+        _fanout(grads)
+        # aggregation phase — the transport under test. Sub-phase 1:
+        # D2H pull + host merge math (concurrent submits; sync mode is
+        # an N-way barrier). Sub-phase 2: every replica pulls the
+        # round's merged basis and adopts it (H2D) — the same
+        # round-synchronous order train.py's thread replicas follow.
+        t0 = time.perf_counter()
+
+        def submit(i: int) -> None:
+            tree = params_of(states[i])           # device → host
+            agg.submit(i, epochs[i], tree, bvs[i],
+                       step=cfg.steps_per_round)  # host merge math
+
+        _fanout(submit)
+
+        def adopt(i: int) -> None:
+            bvs[i], basis = agg.basis(i)
+            if basis is not None:
+                states[i] = adopt_params(
+                    states[i], jax.device_put(basis))  # host → device
+
+        _fanout(adopt)
+        jax.block_until_ready([states[i].actor_params for i in range(n)])
+        if timed:
+            agg_lat.append(time.perf_counter() - t0)
+
+    round_once(timed=False)  # warmup: compile the fused chunk
+    t_start = time.perf_counter()
+    for _ in range(cfg.rounds):
+        round_once(timed=True)
+    wall = time.perf_counter() - t_start
+    agg.close()
+    updates = n * cfg.rounds * cfg.steps_per_round
+    return {
+        "updates_per_sec": round(updates / wall, 1),
+        "wall_s": round(wall, 4),
+        "agg_latency_s": percentile_summary(agg_lat),
+    }
+
+
+def _run_collective_arm(cfg: MeshABConfig, config) -> dict:
+    """The same load through ``MeshReplicaGroup``: one shard_map'd
+    dispatch per chunk, the merge an on-device collective."""
+    from d4pg_tpu.learner.mesh_replicas import MeshReplicaGroup
+
+    group = MeshReplicaGroup(
+        config, _replica_states(config, cfg.n_replicas), k=cfg.k,
+        batch_size=cfg.batch_size, mode=cfg.mode, clip=cfg.clip)
+    group.load(_fill(cfg))
+    group.run_round(cfg.steps_per_round)  # warmup: compile chunk + merge
+    merge_lat: list[float] = []
+    t_start = time.perf_counter()
+    for _ in range(cfg.rounds):
+        group._fused_steps(cfg.steps_per_round)
+        group.merge()  # blocks until the merged tree is ready
+        merge_lat.append(group.last_merge_s)
+    wall = time.perf_counter() - t_start
+    group.close()
+    updates = cfg.n_replicas * cfg.rounds * cfg.steps_per_round
+    return {
+        "updates_per_sec": round(updates / wall, 1),
+        "wall_s": round(wall, 4),
+        "agg_latency_s": percentile_summary(merge_lat),
+    }
+
+
+def run_mesh_ab(cfg: MeshABConfig | None = None, **overrides) -> dict:
+    """One A/B pair at ``cfg.n_replicas``: the socket and collective
+    arms over identical offered load, plus the attribution ratios."""
+    import jax
+
+    cfg = dataclasses.replace(cfg or MeshABConfig(), **overrides)
+    if cfg.n_replicas > len(jax.devices()):
+        raise ValueError(
+            f"n_replicas={cfg.n_replicas} exceeds visible devices "
+            f"({len(jax.devices())}) — the collective arm shards one "
+            "replica per device")
+    config = _learner_config(cfg)
+    socket = _run_socket_arm(cfg, config)
+    collective = _run_collective_arm(cfg, config)
+    p50_s, p50_c = (socket["agg_latency_s"]["p50"],
+                    collective["agg_latency_s"]["p50"])
+    return {
+        "metric": "mesh_learners_ab",
+        "schema": 1,
+        "n_replicas": cfg.n_replicas,
+        "mode": cfg.mode,
+        "clip": cfg.clip,
+        "backend": jax.default_backend(),
+        "load": {
+            "rounds": cfg.rounds,
+            "steps_per_round": cfg.steps_per_round,
+            "k": cfg.k,
+            "batch_size": cfg.batch_size,
+            "obs_dim": cfg.obs_dim,
+            "act_dim": cfg.act_dim,
+            "hidden": list(cfg.hidden),
+        },
+        "socket": socket,
+        "collective": collective,
+        "speedup_updates_per_sec": round(
+            collective["updates_per_sec"] / socket["updates_per_sec"], 3)
+        if socket["updates_per_sec"] else None,
+        "agg_latency_ratio_p50": round(p50_s / p50_c, 3)
+        if p50_s and p50_c else None,
+        "seed": cfg.seed,
+    }

@@ -368,6 +368,39 @@ def run_learners(
             "sweep": sweep, "chaos": chaos_row, "seed": int(seed)}
 
 
+def run_mesh_learners(
+    ns=(1, 2, 4),
+    rounds: int = 6,
+    steps_per_round: int = 8,
+    mode: str = "async",
+    seed: int = 0,
+    **overrides,
+) -> dict:
+    """The bench_fleet mesh_learners block (``fleet/mesh_ab.py``): the
+    socket-vs-collective aggregation A/B at equal offered load per
+    replica count — updates/s on each arm plus per-round aggregation
+    latency p50/p95, the measurement that attributes the mesh-native
+    transport's win to the transport (grad work is identical by
+    construction). Needs a JAX backend with >= max(ns) devices;
+    bench.py runs it in a virtual-device child process so the rest of
+    the fleet suite stays accelerator-free."""
+    import jax
+
+    from d4pg_tpu.fleet.mesh_ab import run_mesh_ab
+
+    sweep = []
+    for n in ns:
+        if int(n) > len(jax.devices()):
+            continue  # the collective arm shards one replica per device
+        sweep.append(run_mesh_ab(
+            n_replicas=int(n), rounds=int(rounds),
+            steps_per_round=int(steps_per_round), mode=mode,
+            seed=int(seed), **overrides))
+    return {"metric": "fleet_mesh_learners", "schema": 1, "mode": mode,
+            "backend": jax.default_backend(), "sweep": sweep,
+            "seed": int(seed)}
+
+
 def run_sampler(
     n_actors: int = 64,
     duration_s: float = 6.0,

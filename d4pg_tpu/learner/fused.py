@@ -152,8 +152,8 @@ def make_sharded_fused_chunk(
     is the per-shard live-row count [n_shards].
     """
     from d4pg_tpu.parallel.compat import shard_map
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from d4pg_tpu.parallel import partition
     from d4pg_tpu.parallel.data_parallel import check_mesh_compatible
     from d4pg_tpu.parallel.mesh import DATA_AXIS
     from d4pg_tpu.replay.sharded_per import ShardedPerTrees
@@ -165,7 +165,7 @@ def make_sharded_fused_chunk(
         raise ValueError(
             f"batch_size {batch_size} not divisible by data axis {n_shards}")
     b_local = batch_size // n_shards
-    Pd, Pr = P(DATA_AXIS), P()
+    Pd, Pr = partition.data_spec(), partition.replicated_spec()
 
     def _local_trees(trees):
         return dper.PerTrees(trees.sum_tree[0], trees.min_tree[0],
@@ -232,9 +232,10 @@ def make_sharded_fused_chunk(
             body, (state, trees), None, length=k)
         return state, trees, metrics
 
-    repl = NamedSharding(mesh, Pr)
-    shard = NamedSharding(mesh, Pd)
-    out_metrics_shard = NamedSharding(mesh, P(None, DATA_AXIS))
+    repl = partition.replicated(mesh)
+    shard = partition.batch_sharding(mesh)
+    state_sh = partition.state_shardings(config, mesh)
+    out_metrics_shard = partition.stacked_sharding(mesh)
     out_metrics = {
         "critic_loss": repl, "actor_loss": repl, "q_mean": repl,
         "td_error": out_metrics_shard, "idx": out_metrics_shard,
@@ -242,8 +243,8 @@ def make_sharded_fused_chunk(
     if prioritized:
         return jax.jit(
             chunk,
-            in_shardings=(repl, shard, shard, shard),
-            out_shardings=(repl, shard, out_metrics),
+            in_shardings=(state_sh, shard, shard, shard),
+            out_shardings=(state_sh, shard, out_metrics),
             donate_argnums=(0, 1) if donate else (),
         )
 
@@ -253,9 +254,10 @@ def make_sharded_fused_chunk(
 
     return jax.jit(
         chunk_u,
-        in_shardings=(repl, shard, shard),
-        out_shardings=(repl, {"critic_loss": repl, "actor_loss": repl,
-                              "q_mean": repl, "td_error": out_metrics_shard,
-                              "idx": out_metrics_shard}),
+        in_shardings=(state_sh, shard, shard),
+        out_shardings=(state_sh, {"critic_loss": repl, "actor_loss": repl,
+                                  "q_mean": repl,
+                                  "td_error": out_metrics_shard,
+                                  "idx": out_metrics_shard}),
         donate_argnums=(0,) if donate else (),
     )

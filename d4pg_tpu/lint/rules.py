@@ -1,6 +1,6 @@
 """The jaxlint rule catalog.
 
-Fourteen rule families, each targeting a hazard that silently costs
+Fifteen rule families, each targeting a hazard that silently costs
 throughput or correctness on this stack (see docs/architecture.md "Static
 analysis & perf sentinels" for the rationale and suppression policy):
 
@@ -12,6 +12,8 @@ analysis & perf sentinels" for the rationale and suppression policy):
 - ``device-put-in-loop``   — per-item H2D transfers in a Python loop
 - ``host-time-in-jit``     — host clock reads / obs-plane calls under a trace
 - ``lock-order``           — service/buffer lock acquired under a shard lock
+- ``sharding-rule-bypass`` — NamedSharding/PartitionSpec built outside the
+  partition-rule core (``parallel/partition.py``)
 - ``lock-cycle``           — interprocedural ABBA cycle in the lock graph
 - ``unguarded-shared-write`` — shared attribute mutated off its owning lock
 - ``wire-magic-registry``  — frame magic/flag bit outside the declared table
@@ -763,6 +765,74 @@ def rule_lock_order(ctx: ModuleContext) -> list[Finding]:
 
 
 # --------------------------------------------------------------------------
+# R15: sharding-rule-bypass
+# --------------------------------------------------------------------------
+
+# The partition-rule core (parallel/partition.py) is the single source of
+# sharding truth: every layout the package places on an array resolves
+# through its regex rule table (or a factory wrapping it), so ONE
+# printable table owns every placement decision. A raw constructor call
+# anywhere else re-opens the hand-wired-axis drift the core closed.
+_SHARDING_CTORS = {"NamedSharding", "PartitionSpec"}
+_SHARDING_MODULES = {"jax.sharding"}
+# dotted-call roots distinctive enough to claim without import tracking
+_SHARDING_ROOTS = {"jax", "sharding", "partition"}
+
+
+def rule_sharding_rule_bypass(ctx: ModuleContext) -> list[Finding]:
+    """Flag ``NamedSharding(...)`` / ``PartitionSpec(...)`` construction —
+    including import aliases (``PartitionSpec as P``, ``partition.PS``) —
+    anywhere outside ``parallel/partition.py``. Layouts come from the
+    rule core (``partition.spec``/``sharding``/``match_partition_rules``
+    or a ``*_sharding`` factory); a raw constructor bypasses the table."""
+    if ctx.path.replace("\\", "/").endswith("parallel/partition.py"):
+        return []  # the rule core is where the constructors BELONG
+
+    aliases: dict[str, str] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod in _SHARDING_MODULES or mod.endswith("parallel.partition"):
+                for a in node.names:
+                    if a.name in _SHARDING_CTORS or a.name == "PS":
+                        canon = ("PartitionSpec" if a.name == "PS"
+                                 else a.name)
+                        aliases[a.asname or a.name] = canon
+        elif (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            # re-aliasing (P = partition.PS): calls through it bypass too
+            src = dotted_name(node.value) or ""
+            if "." in src and last_part(src) in _SHARDING_CTORS | {"PS"}:
+                aliases[node.targets[0].id] = (
+                    "PartitionSpec" if last_part(src) == "PS"
+                    else last_part(src))
+
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = dotted_name(node.func) or ""
+        parts = dotted.split(".")
+        ctor = None
+        if len(parts) == 1:
+            ctor = aliases.get(parts[0])
+        elif parts[0] in _SHARDING_ROOTS:
+            if parts[-1] in _SHARDING_CTORS:
+                ctor = parts[-1]
+            elif parts[-1] == "PS" and parts[0] == "partition":
+                ctor = "PartitionSpec"
+        if ctor is None:
+            continue
+        findings.append(Finding(
+            ctx.path, node.lineno, node.col_offset, "sharding-rule-bypass",
+            f"{ctor} constructed outside parallel/partition.py — resolve "
+            "the layout through the partition-rule core (partition.spec/"
+            "sharding/match_partition_rules or a *_sharding factory) so "
+            "the rule table stays the single source of placement truth"))
+    return findings
+
+
+# --------------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------------
 
@@ -834,6 +904,11 @@ RULES: dict[str, Rule] = {r.id: r for r in [
          "buffer/service lock acquired while holding a shard/ring leaf "
          "lock — the sharded-ingest deadlock shape",
          rule_lock_order),
+    Rule("sharding-rule-bypass",
+         "NamedSharding/PartitionSpec (or an alias: P, partition.PS) "
+         "constructed outside parallel/partition.py — layouts resolve "
+         "through the partition-rule table, not hand-wired axes",
+         rule_sharding_rule_bypass),
     Rule("lock-cycle",
          "cycle in the interprocedural held-while-acquiring lock graph "
          "(ABBA across any number of calls) — see lint/lockgraph.py",

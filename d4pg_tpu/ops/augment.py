@@ -32,7 +32,17 @@ def random_shift(key: Array, imgs: Array, pad: int = 4) -> Array:
     re-cropped to [H, W] at an offset drawn uniformly from
     ``[0, 2*pad]^2`` — i.e. a shift of up to ``pad`` pixels in any
     direction, with edge-replicated fill. dtype-preserving (uint8 in,
-    uint8 out)."""
+    uint8 out).
+
+    Offsets derive from per-sample ``fold_in(key, i)`` keys over a
+    global iota rather than one batch-shaped ``randint(key, (B, 2))``:
+    a single batch-shaped draw is NOT sharding-layout-invariant under
+    GSPMD with the default (non-partitionable) threefry — each data
+    shard would generate different bits than the global computation,
+    so the {data, model}-mesh update would train on different crops
+    than the single-device one (caught by the real-shape equivalence
+    gate in tests/test_mesh_pixels.py). The fold_in form is elementwise
+    in the batch axis, so partitioning preserves values exactly."""
     if imgs.ndim != 4:
         raise ValueError(f"random_shift expects [B, H, W, C], got "
                          f"{imgs.shape}")
@@ -41,9 +51,10 @@ def random_shift(key: Array, imgs: Array, pad: int = 4) -> Array:
     b, h, w, c = imgs.shape
     padded = jnp.pad(imgs, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
                      mode="edge")
-    offsets = jax.random.randint(key, (b, 2), 0, 2 * pad + 1)
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(b))
 
-    def crop(img, off):
+    def crop(img, k):
+        off = jax.random.randint(k, (2,), 0, 2 * pad + 1)
         return jax.lax.dynamic_slice(img, (off[0], off[1], 0), (h, w, c))
 
-    return jax.vmap(crop)(padded, offsets)
+    return jax.vmap(crop)(padded, keys)

@@ -10,7 +10,8 @@ gradient all-reduce inserted by XLA from sharding constraints (or explicit
 the pixel-encoder config can shard activations later (SURVEY.md §2 mandate).
 """
 
-from d4pg_tpu.parallel.mesh import MeshSpec, make_mesh
+from d4pg_tpu.parallel.mesh import MeshSpec, make_mesh, replica_mesh
+from d4pg_tpu.parallel import partition
 from d4pg_tpu.parallel.data_parallel import (
     make_sharded_multi_update,
     make_sharded_update,
@@ -25,6 +26,8 @@ __all__ = [
     "make_mesh",
     "make_sharded_multi_update",
     "make_sharded_update",
+    "partition",
+    "replica_mesh",
     "replicate_state",
     "shard_batch",
     "shard_stacked",

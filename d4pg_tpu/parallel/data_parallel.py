@@ -4,72 +4,67 @@ Replaces the reference's entire distributed-update machinery — grad aliasing
 into shared tensors (``ddpg.py:104-108``), racy ``SharedAdam.step()`` from N
 processes (``shared_adam.py``), weight pull-back (``ddpg.py:118-120``) and
 the 1/n_workers lr rescale (``main.py:384-385``) — with the GSPMD
-formulation: the train state carries a replicated sharding, the batch is
-sharded over the ``data`` axis, and the SAME ``update_step`` used single-chip
-is jit'd with those shardings. ``jnp.mean`` over the global batch inside the
-loss becomes an XLA all-reduce over ICI; every replica then applies an
-identical Adam update — synchronous, deterministic, race-free by
-construction (SURVEY.md §5).
+formulation: the train state carries rule-resolved shardings (replicated
+except where the partition table says otherwise — the pixel encoder's
+``model``-axis tenancy), the batch is sharded over the ``data`` axis, and
+the SAME ``update_step`` used single-chip is jit'd with those shardings.
+``jnp.mean`` over the global batch inside the loss becomes an XLA
+all-reduce over ICI; every replica then applies an identical Adam update —
+synchronous, deterministic, race-free by construction (SURVEY.md §5).
+
+Every sharding here comes from ``parallel/partition.py`` — the single
+source of sharding truth (jaxlint ``sharding-rule-bypass`` enforces it).
 """
 
 from __future__ import annotations
 
-from typing import Any
-
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from d4pg_tpu.learner.state import D4PGConfig, D4PGState
 from d4pg_tpu.learner.update import multi_update_step, update_step
 from d4pg_tpu.replay.uniform import TransitionBatch
 
-from d4pg_tpu.parallel.mesh import DATA_AXIS
+from d4pg_tpu.parallel import partition
 
-
-def _replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())
-
-
-def _batch_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P(DATA_AXIS))
-
-
-def stacked_sharding(mesh: Mesh) -> NamedSharding:
-    """Sharding for [K, B, ...] chunk stacks: K replicated (the scan axis),
-    B split over ``data``. The single source of truth for the stacked
-    layout — used by ``make_sharded_multi_update`` and by the training
-    loop's chunk staging."""
-    return NamedSharding(mesh, P(None, DATA_AXIS))
+# Re-exported for the training loop's chunk staging (the [K, B, ...]
+# layout helper used to live here; partition.py owns it now).
+stacked_sharding = partition.stacked_sharding
 
 
 def replicate_state(state: D4PGState, mesh: Mesh) -> D4PGState:
-    """Place the train state fully replicated over the mesh."""
-    return jax.device_put(state, _replicated(mesh))
+    """Place the train state over the mesh by partition rule — fully
+    replicated for MLP configs; pixel configs put the conv encoder's
+    kernels/biases on the ``model`` axis (``partition.D4PG_RULES``)."""
+    return jax.device_put(state, partition.shardings_for(mesh, state))
 
 
 def shard_batch(batch: TransitionBatch, mesh: Mesh) -> TransitionBatch:
     """Shard a host batch over the ``data`` axis (leading dim split across
     the mesh's data dimension). The batch size must divide evenly."""
-    return jax.device_put(batch, _batch_sharding(mesh))
+    return jax.device_put(batch, partition.batch_sharding(mesh))
 
 
 def shard_stacked(batches, mesh: Mesh):
     """Shard a [K, B, ...] stack of batches: the scan axis K stays
     replicated, B splits over ``data``. Works on any pytree whose leaves
     carry the [K, B, ...] layout (TransitionBatch stacks, weight stacks)."""
-    return jax.device_put(batches, stacked_sharding(mesh))
+    return jax.device_put(batches, partition.stacked_sharding(mesh))
 
 
 def check_mesh_compatible(config: D4PGConfig) -> None:
     """The Pallas projection kernel has no GSPMD partitioning rule — under
     a sharded jit it would fail to compile or silently all-gather the
     batch onto every device. Mesh learners must use the einsum
-    formulation (which shards trivially); fail loudly rather than either."""
+    formulation (which shards trivially); fail loudly rather than either,
+    and print the rule table the mesh layout WOULD resolve to, so the fix
+    (and what it buys) is in the error itself."""
     if config.projection in ("pallas", "pallas_ce"):
         raise ValueError(
             f"--projection {config.projection} is single-device only "
             "(pallas_call does not partition under a sharded jit); use "
-            "--projection einsum with a device mesh"
+            "--projection einsum with a device mesh. Resolved partition "
+            "rules for this mesh:\n" + partition.format_rules()
         )
 
 
@@ -81,16 +76,18 @@ def make_sharded_update(
 ):
     """jit the D4PG update with explicit shardings over ``mesh``.
 
-    in: state replicated, batch + IS weights sharded over ``data``.
-    out: state replicated, scalar metrics replicated, per-sample
-    ``td_error`` sharded over ``data`` (it flows back to the host PER
-    priority update, ``ddpg.py:252-255``).
+    in: state by partition rule, batch + IS weights sharded over
+    ``data``. out: state by the same rules, scalar metrics replicated,
+    per-sample ``td_error`` sharded over ``data`` (it flows back to the
+    host PER priority update, ``ddpg.py:252-255``).
     """
     check_mesh_compatible(config)
-    repl = _replicated(mesh)
-    shard = _batch_sharding(mesh)
+    repl = partition.replicated(mesh)
+    shard = partition.batch_sharding(mesh)
+    state_sh = partition.state_shardings(config, mesh)
 
-    # Shardings as pytree prefixes: a single sharding broadcasts to the tree.
+    # Shardings as pytree prefixes: a single sharding broadcasts to the
+    # tree; the state's is a full rule-resolved tree.
     in_shardings: tuple
     out_metrics = {
         "critic_loss": repl,
@@ -100,14 +97,14 @@ def make_sharded_update(
     }
     if use_is_weights:
         fn = lambda state, batch, w: update_step(config, state, batch, w)
-        in_shardings = (repl, shard, shard)
+        in_shardings = (state_sh, shard, shard)
     else:
         fn = lambda state, batch: update_step(config, state, batch, None)
-        in_shardings = (repl, shard)
+        in_shardings = (state_sh, shard)
     return jax.jit(
         fn,
         in_shardings=in_shardings,
-        out_shardings=(repl, out_metrics),
+        out_shardings=(state_sh, out_metrics),
         donate_argnums=(0,) if donate else (),
     )
 
@@ -124,13 +121,15 @@ def make_sharded_multi_update(
     [B, ...] batch split over the ``data`` axis, gradients all-reduced by
     XLA-inserted collectives over ICI).
 
-    in: state replicated, batches [K, B, ...] + weights [K, B] sharded
-    ``P(None, 'data')``. out: state replicated, scalar metrics stacked [K]
-    replicated, ``td_error`` [K, B] sharded ``P(None, 'data')``.
+    in: state by partition rule, batches [K, B, ...] + weights [K, B]
+    sharded ``stacked_spec()``. out: state by the same rules, scalar
+    metrics stacked [K] replicated, ``td_error`` [K, B] sharded like the
+    batches.
     """
     check_mesh_compatible(config)
-    repl = _replicated(mesh)
-    stacked = stacked_sharding(mesh)
+    repl = partition.replicated(mesh)
+    stacked = partition.stacked_sharding(mesh)
+    state_sh = partition.state_shardings(config, mesh)
     out_metrics = {
         "critic_loss": repl,
         "actor_loss": repl,
@@ -139,13 +138,13 @@ def make_sharded_multi_update(
     }
     if use_is_weights:
         fn = lambda state, batches, w: multi_update_step(config, state, batches, w)
-        in_shardings: tuple = (repl, stacked, stacked)
+        in_shardings: tuple = (state_sh, stacked, stacked)
     else:
         fn = lambda state, batches: multi_update_step(config, state, batches)
-        in_shardings = (repl, stacked)
+        in_shardings = (state_sh, stacked)
     return jax.jit(
         fn,
         in_shardings=in_shardings,
-        out_shardings=(repl, out_metrics),
+        out_shardings=(state_sh, out_metrics),
         donate_argnums=(0,) if donate else (),
     )

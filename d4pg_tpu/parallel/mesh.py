@@ -19,6 +19,10 @@ from jax.sharding import Mesh
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+# Mesh-native learner replicas: an [N, ...]-stacked tree of per-replica
+# states is split along this axis and the aggregator's merge runs as an
+# on-device collective over it (learner/mesh_replicas.py).
+REPLICA_AXIS = "replica"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -48,3 +52,18 @@ def make_mesh(spec: MeshSpec = MeshSpec(), devices=None) -> Mesh:
     dp, mp = spec.resolve(len(devices))
     arr = np.asarray(devices).reshape(dp, mp)
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def replica_mesh(n_replicas: int, devices=None) -> Mesh:
+    """(replica, data, model) mesh for mesh-native learner replicas: one
+    device per replica, with singleton data/model axes so the partition
+    rules resolve on the same axis vocabulary as the learner mesh (any
+    rule spec stays satisfiable over a size-1 axis)."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if n_replicas < 1:
+        raise ValueError(f"n_replicas={n_replicas} must be >= 1")
+    if n_replicas > len(devices):
+        raise ValueError(
+            f"replica mesh needs {n_replicas} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n_replicas]).reshape(n_replicas, 1, 1)
+    return Mesh(arr, (REPLICA_AXIS, DATA_AXIS, MODEL_AXIS))

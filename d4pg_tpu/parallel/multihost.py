@@ -19,9 +19,14 @@ from typing import Optional
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
+from d4pg_tpu.parallel import partition
 from d4pg_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+# Partition specs come from the rule core; P survives only as the type
+# annotation for make_global_batch's optional spec argument.
+P = partition.PS
 
 
 def initialize(coordinator: str, num_processes: int, process_id: int,
@@ -70,11 +75,11 @@ def make_global_batch(local_batch, mesh: Mesh, spec: P | None = None):
     global batch along the ``data`` axis. Each host samples from its OWN
     replay shard (the Ape-X sharded-replay layout); rows never cross hosts.
 
-    ``spec`` defaults to ``P('data')`` (plain [B, ...] batches); pass
-    ``P(None, 'data')`` for stacked [K, B, ...] chunks.
+    ``spec`` defaults to ``partition.data_spec()`` (plain [B, ...]
+    batches); pass ``partition.stacked_spec()`` for [K, B, ...] chunks.
     """
-    spec = P(DATA_AXIS) if spec is None else spec
-    sharding = NamedSharding(mesh, spec)
+    spec = partition.data_spec() if spec is None else spec
+    sharding = partition.sharding(mesh, *spec)
     axis = list(spec).index(DATA_AXIS)
 
     def to_global(x):
@@ -90,7 +95,8 @@ def make_global_batch(local_batch, mesh: Mesh, spec: P | None = None):
 def make_global_chunk(local_chunk, mesh: Mesh):
     """:func:`make_global_batch` for stacked [K, B, ...] chunks (the K scan
     axis replicated, B sharded over ``data``)."""
-    return make_global_batch(local_chunk, mesh, spec=P(None, DATA_AXIS))
+    return make_global_batch(local_chunk, mesh,
+                             spec=partition.stacked_spec())
 
 
 def local_rows(global_array, axis: int = 0) -> np.ndarray:
@@ -132,7 +138,7 @@ def replicate_state_global(init_fn, mesh: Mesh):
     process traces the same ``init_fn`` (same config, same seed) and XLA
     materializes identical replicas everywhere.
     """
-    repl = NamedSharding(mesh, P())
+    repl = partition.replicated(mesh)
     # one-shot by design: jit is the only mechanism that can materialize
     # state on other processes' devices, and this runs once at startup
     return jax.jit(init_fn, out_shardings=repl)()  # jaxlint: disable=recompile-hazard

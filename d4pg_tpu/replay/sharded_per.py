@@ -100,8 +100,8 @@ class ShardedFusedReplay:
     ):
         import jax
         import jax.numpy as jnp
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from d4pg_tpu.parallel import partition
         from d4pg_tpu.parallel.mesh import DATA_AXIS
 
         self.mesh = mesh
@@ -136,7 +136,7 @@ class ShardedFusedReplay:
                     "(process-contiguous device order)")
         self.local_start = self._owned[0] if self._owned else 0
 
-        shard = NamedSharding(mesh, P(DATA_AXIS))
+        shard = partition.batch_sharding(mesh)
         n, c = self.n_shards, self.cap_shard
 
         def _zero_storage():
@@ -219,12 +219,11 @@ class ShardedFusedReplay:
             return self._size.astype(np.int32)
         if self._size_global is None:
             import jax
-            from jax.sharding import NamedSharding, PartitionSpec as P
 
-            from d4pg_tpu.parallel.mesh import DATA_AXIS
+            from d4pg_tpu.parallel import partition
 
             self._size_global = jax.make_array_from_process_local_data(
-                NamedSharding(self.mesh, P(DATA_AXIS)),
+                partition.batch_sharding(self.mesh),
                 self._size.astype(np.int32), (self.n_shards,))
         return self._size_global
 
@@ -237,9 +236,8 @@ class ShardedFusedReplay:
         convention) discard."""
         import jax
         from d4pg_tpu.parallel.compat import shard_map
-        from jax.sharding import PartitionSpec as P
 
-        from d4pg_tpu.parallel.mesh import DATA_AXIS
+        from d4pg_tpu.parallel import partition
         from d4pg_tpu.replay import device_per as dper
 
         alpha = self.alpha
@@ -260,7 +258,7 @@ class ShardedFusedReplay:
             return new_storage, ShardedPerTrees(
                 t.sum_tree[None], t.min_tree[None], t.max_priority[None])
 
-        specs = P(DATA_AXIS)
+        specs = partition.data_spec()
         if self.trees is not None:
             fn = shard_map(
                 local_insert, mesh=self.mesh,
@@ -354,11 +352,10 @@ class ShardedFusedReplay:
         [n_shards, m, ...] arrays sharded over the data axis (each process
         contributes its own block; nothing crosses DCN)."""
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from d4pg_tpu.parallel.mesh import DATA_AXIS
+        from d4pg_tpu.parallel import partition
 
-        shard = NamedSharding(self.mesh, P(DATA_AXIS))
+        shard = partition.batch_sharding(self.mesh)
 
         def to_global(x):
             x = np.asarray(x)
@@ -409,9 +406,8 @@ class ShardedFusedReplay:
         snapshot availability across hosts before any host calls this)."""
         import jax
         import jax.numpy as jnp
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from d4pg_tpu.parallel.mesh import DATA_AXIS
+        from d4pg_tpu.parallel import partition
 
         s = d.get("sharded")
         if s is None:
@@ -433,7 +429,7 @@ class ShardedFusedReplay:
                 "host topology (process count and devices per host)")
         validate_rows({k: v for k, v in d.items() if k != "sharded"},
                       self.capacity)
-        shard = NamedSharding(self.mesh, P(DATA_AXIS))
+        shard = partition.batch_sharding(self.mesh)
         n, c = self.n_local, self.cap_shard
 
         def to_global(x):

@@ -754,10 +754,11 @@ def train(cfg: ExperimentConfig) -> dict:
     # filled by the multi-learner block below (--learners N > 1); empty
     # means the legacy single-learner paths own the weight stream
     replicas: list = []
+    mesh_group = None  # mesh-native replica group (collective transport)
 
     def publish():
-        if replicas:
-            return  # the aggregator owns the version stream (one writer)
+        if replicas or mesh_group is not None:
+            return  # the merge owns the version stream (one writer)
         p = state.actor_params if mesh is None else jax.device_get(state.actor_params)
         weights.publish(p, step=lstep, norm_stats=_norm_snapshot())
 
@@ -953,6 +954,8 @@ def train(cfg: ExperimentConfig) -> dict:
     def train_steps(n: int):
         """n updates: pipelined K-chunks, then single-dispatch remainder."""
         nonlocal state
+        if mesh_group is not None:
+            return train_steps_mesh(n)
         if replicas:
             return train_steps_multi(n)
         if fused:
@@ -996,17 +999,45 @@ def train(cfg: ExperimentConfig) -> dict:
                 "--learners > 1 / --sample_on_ingest need the host-sampled "
                 "replay path (fused device replay is single-consumer by "
                 "construction — pass --fused_replay off)")
-        if multi_host or mesh is not None:
+        # Merge transport (--agg_transport): 'collective' runs the
+        # replicas mesh-native (learner/mesh_replicas.py — full states
+        # stacked along the 'replica' mesh axis by partition rule, the
+        # merge an on-device collective); 'socket' is the PR-10
+        # host-thread plane over 0xD4AB frames and stays the cross-host
+        # fallback. 'auto' picks collective exactly when the replicas
+        # can share one single-host mesh.
+        transport = cfg.agg_transport
+        if transport == "auto":
+            transport = ("collective"
+                         if (mesh is not None and not multi_host
+                             and cfg.learners > 1
+                             and not cfg.sample_on_ingest)
+                         else "socket")
+        if transport == "collective":
+            if mesh is None or multi_host:
+                raise ValueError(
+                    "--agg_transport collective needs the replicas on one "
+                    "single-host device mesh (--data_parallel/"
+                    "--model_parallel); across hosts the socket update "
+                    "plane is the fallback")
+            if cfg.sample_on_ingest:
+                raise ValueError(
+                    "--sample_on_ingest deals blocks to host-thread "
+                    "replicas — pair it with --agg_transport socket")
+            if cfg.learners < 2:
+                raise ValueError(
+                    "--agg_transport collective needs --learners > 1 "
+                    "(with one learner the plain mesh path already "
+                    "covers the device layout)")
+        elif multi_host or mesh is not None:
             raise ValueError(
-                "--learners > 1 / --sample_on_ingest compose with "
-                "single-host unmeshed learners only (scale within a host "
-                "first)")
+                "--agg_transport socket composes with single-host "
+                "unmeshed learners only; replicas sharing a device mesh "
+                "take --agg_transport collective (the mesh-native merge)")
         if cfg.sample_on_ingest and not cfg.prioritized_replay:
             raise ValueError(
                 "--sample_on_ingest is the PER dealer — it needs "
                 "--p_replay (dealt blocks carry IS weights)")
-        from d4pg_tpu.learner.aggregator import Aggregator
-        from d4pg_tpu.learner.replica import LearnerReplica
         from d4pg_tpu.replay.schedule import SharedBetaSchedule
 
         n_learners = max(1, cfg.learners)
@@ -1015,46 +1046,74 @@ def train(cfg: ExperimentConfig) -> dict:
         # stamps it onto the blocks it deals)
         beta_sched = SharedBetaSchedule(beta0=cfg.per_beta0,
                                         beta_steps=cfg.per_beta_steps)
-        dealt_rings: list = []
-        if cfg.sample_on_ingest:
-            from d4pg_tpu.replay.sampler import SampleDealer
-            from d4pg_tpu.replay.staging import DealtBlockRing
+        if transport == "collective":
+            from d4pg_tpu.learner.mesh_replicas import MeshReplicaGroup
 
-            dealt_rings = [DealtBlockRing(4) for _ in range(n_learners)]
-            dealer = SampleDealer(
-                cfg.memory_size, dealt_rings,
-                n_shards=cfg.ingest_shards, k=K,
-                batch_size=cfg.batch_size, alpha=cfg.per_alpha,
-                beta_schedule=beta_sched,
-                min_size=max(1, cfg.batch_size), seed=cfg.seed)
-            service.attach_dealer(dealer)
-        aggregator = Aggregator(
-            weights, mode=cfg.agg_mode, clip=cfg.agg_clip,
-            # actors pull acting params only; the full 4-subtree merge
-            # tree stays between replicas and aggregator
-            extract=lambda tree: tree["actor_params"],
-            norm_stats=_norm_snapshot)
-        for i in range(n_learners):
-            # identical network init across replicas, decorrelated
-            # sampling/noise keys (replica 0 keeps the original chain).
-            # Every replica gets its OWN buffer copy: updates donate
-            # their input state, and donated leaves shared between
-            # replicas would be deleted under each other
-            rstate = jax.tree_util.tree_map(jnp.copy, state)
-            if i:
-                rstate = rstate._replace(
-                    key=jax.random.fold_in(rstate.key, i))
-            replicas.append(LearnerReplica(
-                i, config, aggregator, rstate, k=K,
-                batch_size=cfg.batch_size,
+            rstates = []
+            for i in range(n_learners):
+                # same replica construction as the socket path below:
+                # identical nets, decorrelated keys, per-replica leaf
+                # copies (the stacking device_put consumes its inputs)
+                rstate = jax.tree_util.tree_map(jnp.copy, state)
+                if i:
+                    rstate = rstate._replace(
+                        key=jax.random.fold_in(rstate.key, i))
+                rstates.append(rstate)
+            mesh_group = MeshReplicaGroup(
+                config, rstates, k=K, batch_size=cfg.batch_size,
+                mode=cfg.agg_mode, clip=cfg.agg_clip, store=weights,
+                # actors pull acting params only, as with the aggregator
+                extract=lambda tree: tree["actor_params"],
+                norm_stats=_norm_snapshot,
                 prioritized=cfg.prioritized_replay, alpha=cfg.per_alpha,
-                beta0=cfg.per_beta0, beta_steps=cfg.per_beta_steps,
-                service=service,
-                dealt_ring=dealt_rings[i] if dealt_rings else None,
-                beta_schedule=beta_sched))
-        print(f"learner plane: {n_learners} replicas, "
-              f"mode={cfg.agg_mode} clip={cfg.agg_clip} "
-              f"sample_on_ingest={cfg.sample_on_ingest}", flush=True)
+                beta0=cfg.per_beta0, beta_steps=cfg.per_beta_steps)
+            print(f"learner plane: {n_learners} mesh-native replicas "
+                  f"(collective merge), mode={cfg.agg_mode} "
+                  f"clip={cfg.agg_clip}", flush=True)
+        else:
+            from d4pg_tpu.learner.aggregator import Aggregator
+            from d4pg_tpu.learner.replica import LearnerReplica
+
+            dealt_rings: list = []
+            if cfg.sample_on_ingest:
+                from d4pg_tpu.replay.sampler import SampleDealer
+                from d4pg_tpu.replay.staging import DealtBlockRing
+
+                dealt_rings = [DealtBlockRing(4) for _ in range(n_learners)]
+                dealer = SampleDealer(
+                    cfg.memory_size, dealt_rings,
+                    n_shards=cfg.ingest_shards, k=K,
+                    batch_size=cfg.batch_size, alpha=cfg.per_alpha,
+                    beta_schedule=beta_sched,
+                    min_size=max(1, cfg.batch_size), seed=cfg.seed)
+                service.attach_dealer(dealer)
+            aggregator = Aggregator(
+                weights, mode=cfg.agg_mode, clip=cfg.agg_clip,
+                # actors pull acting params only; the full 4-subtree merge
+                # tree stays between replicas and aggregator
+                extract=lambda tree: tree["actor_params"],
+                norm_stats=_norm_snapshot)
+            for i in range(n_learners):
+                # identical network init across replicas, decorrelated
+                # sampling/noise keys (replica 0 keeps the original chain).
+                # Every replica gets its OWN buffer copy: updates donate
+                # their input state, and donated leaves shared between
+                # replicas would be deleted under each other
+                rstate = jax.tree_util.tree_map(jnp.copy, state)
+                if i:
+                    rstate = rstate._replace(
+                        key=jax.random.fold_in(rstate.key, i))
+                replicas.append(LearnerReplica(
+                    i, config, aggregator, rstate, k=K,
+                    batch_size=cfg.batch_size,
+                    prioritized=cfg.prioritized_replay, alpha=cfg.per_alpha,
+                    beta0=cfg.per_beta0, beta_steps=cfg.per_beta_steps,
+                    service=service,
+                    dealt_ring=dealt_rings[i] if dealt_rings else None,
+                    beta_schedule=beta_sched))
+            print(f"learner plane: {n_learners} replicas, "
+                  f"mode={cfg.agg_mode} clip={cfg.agg_clip} "
+                  f"sample_on_ingest={cfg.sample_on_ingest}", flush=True)
 
     def train_steps_multi(n: int):
         """Fan the cycle's n grad steps across the replicas: each runs
@@ -1102,6 +1161,58 @@ def train(cfg: ExperimentConfig) -> dict:
         if metrics is None:
             return None
         return {name: metrics[name][-1]
+                for name in ("critic_loss", "actor_loss", "q_mean")}
+
+    def train_steps_mesh(n: int):
+        """The cycle's grad steps on the mesh-native replica group:
+        every replica trains ceil(n/N) service-sampled steps against its
+        own shard of the replica-stacked state — one [N, K, B, ...]
+        dispatch per chunk — then the round closes with the on-device
+        collective merge + publish. The socket path's per-round
+        device→host pull, 0xD4AB frame and host→device push never
+        happen; semantics stay round-synchronous (replica i's
+        submission at lag i in async mode)."""
+        nonlocal state, lstep
+        per = -(-n // mesh_group.n)
+        # one beta per round, shared by every replica's sampler — the
+        # same anneal clock the thread replicas read
+        beta_now = beta_sched.beta_at(beta_sched.current_step())
+        metrics = None
+        done = 0
+        while done < per:
+            k = min(K, per - done)
+            if cfg.prioritized_replay:
+                chunks = [service.sample_chunk(
+                    k, cfg.batch_size, beta=beta_now,
+                    weight_base=service.weight_base())
+                    for _ in range(mesh_group.n)]
+                batches = jax.tree_util.tree_map(
+                    lambda *xs: np.stack(xs), *[c[0] for c in chunks])
+                w = np.stack(
+                    [np.asarray(c[1], np.float32) for c in chunks])
+                metrics = mesh_group.step_host_chunks(batches, w)
+                # [N, K, B] — replica i's td rows pay back the
+                # priorities of the rows IT sampled
+                td = np.asarray(metrics["td_error"])
+                for i, c in enumerate(chunks):
+                    service.update_priorities(
+                        c[2], np.abs(td[i]) + 1e-6, generation=c[3])
+            else:
+                chunks = [service.sample_chunk(k, cfg.batch_size)
+                          for _ in range(mesh_group.n)]
+                batches = jax.tree_util.tree_map(
+                    lambda *xs: np.stack(xs), *[c[0] for c in chunks])
+                metrics = mesh_group.step_host_chunks(batches)
+            done += k
+        beta_sched.advance(per)
+        mesh_group.merge()
+        # replica 0's slice stands in for `state` downstream (checkpoint,
+        # eval lag accounting); the PUBLISHED params are the merged tree
+        state = mesh_group.state_slice(0)
+        lstep = max(lstep, mesh_group.steps_done)
+        if metrics is None:
+            return None
+        return {name: np.asarray(metrics[name])[0, -1]
                 for name in ("critic_loss", "actor_loss", "q_mean")}
 
     stop_actors = threading.Event()
@@ -1315,6 +1426,8 @@ def train(cfg: ExperimentConfig) -> dict:
         r.close()
     if aggregator is not None:
         aggregator.close()
+    if mesh_group is not None:
+        mesh_group.close()
     if fused_loop is not None:
         fused_loop.close()
     if receiver is not None:

@@ -302,10 +302,11 @@ def test_rule_catalog_covers_all_families():
     assert set(RULES) == {
         "prng-key-reuse", "host-sync-in-jit", "recompile-hazard",
         "use-after-donation", "tracer-leak", "device-put-in-loop",
-        "host-time-in-jit", "lock-order", "lock-cycle",
-        "unguarded-shared-write", "wire-magic-registry",
+        "host-time-in-jit", "lock-order", "sharding-rule-bypass",
+        "lock-cycle", "unguarded-shared-write", "wire-magic-registry",
         "codec-asymmetry", "unchecked-frame", "flag-bit-collision",
     }
+    assert RULES["sharding-rule-bypass"].scope == "module"
     # the lock-graph and wire-graph families analyze whole programs,
     # not single modules
     assert RULES["lock-cycle"].scope == "program"
@@ -490,6 +491,80 @@ def test_host_time_in_jit_suppressible():
         """), "fixture.py")
     assert [f for f in res.findings if f.rule == "host-time-in-jit"] == []
     assert any(f.rule == "host-time-in-jit" for f in res.suppressed)
+
+
+# ------------------------------------- R15: sharding-rule-bypass ----------
+
+def test_sharding_rule_bypass_fires_on_direct_and_aliased_ctors():
+    out = findings("""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def place(mesh, x):
+            sh = NamedSharding(mesh, P("data"))
+            qualified = jax.sharding.PartitionSpec(None, "model")
+            return jax.device_put(x, sh), qualified
+        """, "sharding-rule-bypass")
+    assert len(out) == 3
+    assert all("partition-rule" in f.message for f in out)
+
+
+def test_sharding_rule_bypass_fires_on_partition_ps_realias():
+    # calling through a re-alias of the core's own PS export is the
+    # same bypass: the spec skips the rule table
+    out = findings("""
+        from d4pg_tpu.parallel import partition
+
+        P = partition.PS
+
+        def spec_for(name):
+            return P("data") if name else partition.PS()
+        """, "sharding-rule-bypass")
+    assert len(out) == 2
+
+
+def test_sharding_rule_bypass_clean_through_rule_core():
+    # resolving layouts THROUGH the core (factories + rule matching) and
+    # merely importing Mesh / annotating with PS are all fine
+    out = findings("""
+        from jax.sharding import Mesh
+
+        from d4pg_tpu.parallel import partition
+
+        def place(mesh, tree, x):
+            sh = partition.batch_sharding(mesh)
+            specs = partition.match_partition_rules(
+                partition.D4PG_RULES, tree)
+            return sh, specs, partition.sharding(mesh, "data")
+        """, "sharding-rule-bypass")
+    assert out == []
+
+
+def test_sharding_rule_bypass_exempts_partition_core():
+    res = lint_source(textwrap.dedent("""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        def spec(*axes):
+            return PartitionSpec(*axes)
+
+        def sharding(mesh, *axes):
+            return NamedSharding(mesh, spec(*axes))
+        """), "d4pg_tpu/parallel/partition.py")
+    assert [f for f in res.findings
+            if f.rule == "sharding-rule-bypass"] == []
+
+
+def test_sharding_rule_bypass_suppressible():
+    res = lint_source(textwrap.dedent("""
+        from jax.sharding import PartitionSpec
+
+        def exotic(mesh):
+            # layout experiment outside the table on purpose (bench-only)
+            return PartitionSpec("data")  # jaxlint: disable=sharding-rule-bypass
+        """), "fixture.py")
+    assert [f for f in res.findings
+            if f.rule == "sharding-rule-bypass"] == []
+    assert any(f.rule == "sharding-rule-bypass" for f in res.suppressed)
 
 
 # ------------------------------------------------- R8: lock-cycle ---------

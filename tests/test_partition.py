@@ -1,0 +1,162 @@
+"""Partition-rule engine: the contracts ISSUE 14 pins.
+
+Scalar leaves are never partitioned, first match wins, unmatched keys
+fail loudly with the resolved table, and shard->gather round-trips
+bitwise over the 8 virtual devices the suite runs on. These are the
+semantics every sharded jit in the framework now inherits from
+``parallel/partition.py``, so they get direct coverage rather than
+riding along inside the mesh integration tests.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from d4pg_tpu.parallel import MeshSpec, make_mesh, partition, replica_mesh
+from d4pg_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, REPLICA_AXIS
+
+PS = partition.PS
+
+pytestmark = pytest.mark.mesh
+
+
+def _tree():
+    return {
+        "encoder": {
+            "conv1": {"kernel": np.ones((3, 3, 4, 8), np.float32),
+                      "bias": np.ones((8,), np.float32)},
+        },
+        "fc1": {"kernel": np.ones((8, 16), np.float32),
+                "bias": np.ones((16,), np.float32)},
+        "step": np.zeros((), np.int32),
+        "scale": np.ones((1,), np.float32),
+    }
+
+
+class TestMatching:
+    def test_scalar_leaves_never_partitioned(self):
+        # Even a catch-all rule that shards everything cannot touch
+        # ndim-0 or size-1 leaves: step counters and Adam `count` must
+        # stay replicated or the update math breaks.
+        rules = ((r".*", PS(DATA_AXIS)),)
+        specs = partition.match_partition_rules(rules, _tree())
+        assert specs["step"] == PS()
+        assert specs["scale"] == PS()
+        assert specs["fc1"]["kernel"] == PS(DATA_AXIS)
+
+    def test_first_match_wins(self):
+        rules = (
+            (r"encoder/conv\d+/kernel", PS(None, None, None, MODEL_AXIS)),
+            (r"kernel", PS(DATA_AXIS)),  # would also match conv kernels
+            (r".*", PS()),
+        )
+        specs = partition.match_partition_rules(rules, _tree())
+        assert specs["encoder"]["conv1"]["kernel"] == PS(
+            None, None, None, MODEL_AXIS)
+        assert specs["fc1"]["kernel"] == PS(DATA_AXIS)
+        assert specs["fc1"]["bias"] == PS()
+
+    def test_unmatched_key_fails_loudly(self):
+        rules = ((r"kernel", PS()),)  # biases match nothing
+        with pytest.raises(ValueError) as e:
+            partition.match_partition_rules(rules, _tree())
+        msg = str(e.value)
+        assert "bias" in msg           # the offending leaf's path
+        assert "kernel" in msg         # the resolved table is printed
+
+    def test_production_rules_are_total(self):
+        # D4PG_RULES must resolve every leaf of a real pixel state —
+        # the catch-all guarantees totality, the conv rules claim the
+        # model axis.
+        from d4pg_tpu.config import ExperimentConfig
+
+        cfg = ExperimentConfig(
+            env="pixel-point", share_encoder=True, frame_stack=3,
+            augment="shift", augment_pad=1, encoder_width=8,
+            batch_size=16, n_atoms=11, hidden=(16, 16),
+        ).resolve().learner_config(obs_dim=(8, 8, 9), act_dim=2)
+        specs = partition.state_specs(cfg)
+        flat: list[tuple[str, PS]] = []
+        partition.named_tree_map(
+            lambda n, s: flat.append((n, s)) or s, specs)
+        by_name = dict(flat)
+        assert by_name["actor_params/params/encoder/conv1/kernel"] == PS(
+            None, None, None, MODEL_AXIS)
+        assert by_name["actor_params/params/encoder/conv1/bias"] == PS(
+            MODEL_AXIS)
+        # Adam moments mirror the param placement (re.search finds the
+        # param path inside the optimizer path).
+        assert by_name[
+            "actor_opt_state/0/mu/params/encoder/conv1/kernel"] == PS(
+            None, None, None, MODEL_AXIS)
+        assert by_name["step"] == PS()
+        assert by_name["key"] == PS()
+
+
+class TestNaming:
+    def test_named_flat_roundtrip(self):
+        params = _tree()
+        flat = partition.named_flat(
+            {k: v for k, v in params.items() if isinstance(v, dict)})
+        assert "encoder/conv1/kernel" in flat
+        back = partition.named_unflat(flat)
+        assert back["encoder"]["conv1"]["kernel"].shape == (3, 3, 4, 8)
+
+    def test_named_tree_map_handles_namedtuples_and_none(self):
+        import collections
+
+        Pair = collections.namedtuple("Pair", ["a", "b"])
+        tree = Pair(a={"x": np.ones(3)}, b=(None, [np.zeros(2)]))
+        names = partition.tree_names(tree)
+        assert names == ["a/x", "b/1/0"]
+
+
+class TestPlacement:
+    def test_shard_gather_bitwise_roundtrip(self):
+        # 8 virtual devices (conftest). Random payloads survive a
+        # shard->gather cycle bit-for-bit.
+        mesh = make_mesh(MeshSpec(data_parallel=4, model_parallel=2))
+        rng = np.random.default_rng(0)
+        tree = {
+            "encoder": {"conv1": {
+                "kernel": rng.standard_normal((3, 3, 4, 8)).astype(np.float32),
+                "bias": rng.standard_normal((8,)).astype(np.float32)}},
+            "fc1": {"kernel": rng.standard_normal((16, 32)).astype(np.float32)},
+        }
+        shardings = partition.shardings_for(mesh, tree)
+        shard_fns, gather_fns = partition.make_shard_and_gather_fns(shardings)
+        placed = jax.tree_util.tree_map(lambda f, x: f(x), shard_fns, tree)
+        back = jax.tree_util.tree_map(lambda f, x: f(x), gather_fns, placed)
+        jax.tree_util.tree_map(np.testing.assert_array_equal, tree, back)
+        # and the conv kernel actually landed on the model axis
+        k = placed["encoder"]["conv1"]["kernel"]
+        assert k.sharding.spec == PS(None, None, None, MODEL_AXIS)
+
+    def test_replica_stack_shardings(self):
+        mesh = replica_mesh(2)
+        tree = {"fc1": {"kernel": np.ones((4, 4), np.float32)},
+                "step": np.zeros((), np.int32)}
+        stacked = jax.tree_util.tree_map(
+            lambda x: jnp.stack([x, x]), tree)
+        placed = jax.device_put(
+            stacked, partition.replica_stack_shardings(mesh, tree))
+        assert placed["fc1"]["kernel"].sharding.spec == PS(REPLICA_AXIS)
+        # scalars become [N]-vectors split over replica — still one
+        # value per replica, never partitioned within a replica
+        assert placed["step"].sharding.spec == PS(REPLICA_AXIS)
+
+    def test_state_shardings_match_replicate_state(self):
+        from d4pg_tpu.config import ExperimentConfig
+        from d4pg_tpu.learner.state import init_state
+        from d4pg_tpu.parallel import replicate_state
+
+        cfg = ExperimentConfig(
+            batch_size=16, n_atoms=11, hidden=(8, 8),
+        ).resolve().learner_config(obs_dim=3, act_dim=2)
+        st = init_state(cfg, jax.random.key(0))
+        mesh = make_mesh(MeshSpec(data_parallel=4, model_parallel=2))
+        placed = replicate_state(st, mesh)
+        want = partition.state_shardings(cfg, mesh)
+        assert placed.actor_params["params"]["fc1"][
+            "kernel"].sharding == want.actor_params["params"]["fc1"]["kernel"]
