@@ -18,6 +18,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from d4pg_tpu.envs.wrappers import flatten_goal_obs, rescale_action
+from d4pg_tpu.obs.containment import contained_crash
 from d4pg_tpu.learner.state import D4PGConfig
 from d4pg_tpu.distributed.weights import WeightStore
 from d4pg_tpu.serving.client import ActorConfig, LocalPolicyClient
@@ -166,20 +167,23 @@ class AsyncEvaluator:
         return self.latest()
 
     def _loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                n_trials, seed = self._requests.get(timeout=0.1)
-            except queue.Empty:
-                continue
-            try:
-                result = self._ev.evaluate(n_trials, seed=seed)
-                with self._lock:
-                    self._latest = result
-            except Exception as e:  # noqa: BLE001 — eval crash must not kill training
-                print(f"evaluator failed: {e!r}", flush=True)
-            finally:
-                with self._lock:
-                    self._outstanding -= 1
+        try:
+            while not self._stop.is_set():
+                try:
+                    n_trials, seed = self._requests.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                try:
+                    result = self._ev.evaluate(n_trials, seed=seed)
+                    with self._lock:
+                        self._latest = result
+                except Exception as e:  # noqa: BLE001 — eval crash must not kill training
+                    print(f"evaluator failed: {e!r}", flush=True)
+                finally:
+                    with self._lock:
+                        self._outstanding -= 1
+        except Exception as e:
+            contained_crash("evaluator.loop", e)
 
     def close(self) -> None:
         self._stop.set()

@@ -54,6 +54,7 @@ import numpy as np
 
 from d4pg_tpu.core.locking import TieredCondition, TieredLock
 from d4pg_tpu.distributed.transport import decode_frame, raw_frame_meta_ex
+from d4pg_tpu.obs.containment import contained_crash
 from d4pg_tpu.obs.flight import record_event
 from d4pg_tpu.obs.registry import REGISTRY
 from d4pg_tpu.obs.trace import RECORDER as _tracer
@@ -757,6 +758,12 @@ class ReplayService:
         a slow commit still backs pressure up into the shard deque where
         the shed watermark / blocking-add contract lives, exactly like
         the single drain thread it replaces."""
+        try:
+            self._worker_loop(s)
+        except Exception as e:
+            contained_crash("ingest.shard_worker", e)
+
+    def _worker_loop(self, s: _IngestShard) -> None:
         while not self._stop.is_set():
             dealer = self._dealer
             if dealer is not None:
@@ -866,6 +873,12 @@ class ReplayService:
         """The single writer of replay state: ordered K-way merge of the
         shard outputs, normalizer fold, one buffer-lock acquisition per
         merged group."""
+        try:
+            self._commit_run()
+        except Exception as e:
+            contained_crash("ingest.commit", e)
+
+    def _commit_run(self) -> None:
         last_progress = time.monotonic()
         while True:
             group: list = []

@@ -55,6 +55,7 @@ from d4pg_tpu.core.wire import (
     RAW_TRACE as _RAW_TRACE,
     ingest_v2_layout as _ingest_v2_layout,
 )
+from d4pg_tpu.obs.containment import contained_crash
 from d4pg_tpu.obs.flight import record_event
 from d4pg_tpu.replay.uniform import TransitionBatch
 
@@ -825,29 +826,41 @@ class TransitionReceiver(ConnRegistry):
             t.start()
 
     def _accept(self, server: socket.socket, listener_idx: int) -> None:
-        while not self._stop.is_set():
-            try:
-                server.settimeout(0.2)
-                conn, _ = server.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                return
-            if self.reuseport:
-                shard = listener_idx
-            else:
-                shard = self._rr % self.num_shards
-                self._rr += 1
-            # reap finished connection threads (a long-lived service with a
-            # churning fleet otherwise grows this list without bound)
-            self._threads = [t for t in self._threads if t.is_alive()]
-            self._register_conn(conn)
-            t = threading.Thread(target=self._serve, args=(conn, shard),
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
+        try:
+            while not self._stop.is_set():
+                try:
+                    server.settimeout(0.2)
+                    conn, _ = server.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                if self.reuseport:
+                    shard = listener_idx
+                else:
+                    shard = self._rr % self.num_shards
+                    self._rr += 1
+                # reap finished connection threads (a long-lived service
+                # with a churning fleet otherwise grows this list without
+                # bound)
+                self._threads = [t for t in self._threads if t.is_alive()]
+                self._register_conn(conn)
+                t = threading.Thread(target=self._serve, args=(conn, shard),
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+        except Exception as e:
+            contained_crash("ingest.accept", e)
 
     def _serve(self, conn: socket.socket, shard: int = 0) -> None:
+        try:
+            self._serve_conn(conn, shard)
+        except Exception as e:
+            # a raising _on_payload/_on_batch callback must not silently
+            # kill the connection thread
+            contained_crash("ingest.serve", e)
+
+    def _serve_conn(self, conn: socket.socket, shard: int = 0) -> None:
         try:
             with conn:
                 if not server_handshake(conn, self._secret):

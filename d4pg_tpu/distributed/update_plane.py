@@ -68,6 +68,7 @@ from d4pg_tpu.distributed.transport import (
 )
 from d4pg_tpu.distributed.weight_plane import decode_flat, encode_flat
 from d4pg_tpu.distributed.weight_server import _flatten, _unflatten
+from d4pg_tpu.obs.containment import contained_crash
 from d4pg_tpu.obs.flight import record_event
 from d4pg_tpu.obs.trace import RECORDER as TRACE, new_trace_id
 
@@ -159,6 +160,7 @@ class AggregatorServer(ConnRegistry):
         self.applied = 0
         self.fenced_header = 0   # zero-decode header fences
         self.fenced_submit = 0   # aggregator-level fences
+        self.barrier_timeouts = 0
         self.torn = 0
         self.bytes_in = 0
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -172,74 +174,97 @@ class AggregatorServer(ConnRegistry):
         self._thread.start()
 
     def _accept(self) -> None:
-        while not self._stop.is_set():
-            try:
-                self._server.settimeout(0.2)
-                conn, _ = self._server.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                return
-            self._register_conn(conn)
-            self._conn_threads = [t for t in self._conn_threads
-                                  if t.is_alive()]
-            t = threading.Thread(target=self._serve, args=(conn,),
-                                 daemon=True)
-            self._conn_threads.append(t)
-            t.start()
+        try:
+            while not self._stop.is_set():
+                try:
+                    self._server.settimeout(0.2)
+                    conn, _ = self._server.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                self._register_conn(conn)
+                self._conn_threads = [t for t in self._conn_threads
+                                      if t.is_alive()]
+                t = threading.Thread(target=self._serve, args=(conn,),
+                                     daemon=True)
+                self._conn_threads.append(t)
+                t.start()
+        except Exception as e:
+            contained_crash("updates.accept", e)
 
     def _handle_frame(self, frame: bytes) -> tuple[int, dict]:
         """(status_id, result) for one complete frame — shared by the
         socket path and tests that drive raw bytes."""
         self.frames += 1
         self.bytes_in += len(frame)
-        meta = update_frame_meta(frame)
-        tid = meta["trace_id"]
-        if tid:
-            TRACE.begin(tid, meta["birth_ts"])
-            TRACE.record_span(tid, "admission")
-        live = self._agg.live_epoch(meta["replica_id"])
-        if live != meta["epoch"]:
-            # the chaos hot path: dead epoch bounced off the header,
-            # payload never decoded
-            self.fenced_header += 1
-            if tid:
-                TRACE.terminal_shed(tid)
-            record_event("update_header_fenced", replica=meta["replica_id"],
-                         epoch=meta["epoch"], live_epoch=live)
-            return STATUS_FENCED, {"version": self._agg.version}
+        tid = 0
         try:
-            meta, params = decode_update(frame)
-        except (ProtocolError, ValueError, KeyError, TypeError, OSError,
-                zipfile.BadZipFile):
-            # ProtocolError covers length/crc tears; the rest come out
-            # of np.load/decode_flat on a crc-VALID but garbage body
-            # (the sender checksummed corrupt bytes). Either way: torn,
-            # counted, acked, connection stays alive.
-            self.torn += 1
+            meta = update_frame_meta(frame)
+            tid = meta["trace_id"]
             if tid:
-                TRACE.terminal_shed(tid)
-            record_event("update_torn", replica=meta["replica_id"])
-            return STATUS_TORN, {"version": self._agg.version}
-        if tid:
-            TRACE.record_span(tid, "decode")
-        result = self._agg.submit(
-            meta["replica_id"], meta["epoch"], params,
-            meta["basis_version"], step=meta["step"],
-            generation=meta["generation"])
-        status = _STATUS_IDS.get(result["status"], STATUS_FENCED)
-        if status == STATUS_APPLIED:
-            self.applied += 1
+                TRACE.begin(tid, meta["birth_ts"])
+                TRACE.record_span(tid, "admission")
+            live = self._agg.live_epoch(meta["replica_id"])
+            if live != meta["epoch"]:
+                # the chaos hot path: dead epoch bounced off the header,
+                # payload never decoded
+                self.fenced_header += 1
+                if tid:
+                    TRACE.terminal_shed(tid)
+                record_event("update_header_fenced",
+                             replica=meta["replica_id"],
+                             epoch=meta["epoch"], live_epoch=live)
+                return STATUS_FENCED, {"version": self._agg.version}
+            try:
+                meta, params = decode_update(frame)
+            except (ProtocolError, ValueError, KeyError, TypeError, OSError,
+                    zipfile.BadZipFile):
+                # ProtocolError covers length/crc tears; the rest come out
+                # of np.load/decode_flat on a crc-VALID but garbage body
+                # (the sender checksummed corrupt bytes). Either way: torn,
+                # counted, acked, connection stays alive.
+                self.torn += 1
+                if tid:
+                    TRACE.terminal_shed(tid)
+                record_event("update_torn", replica=meta["replica_id"])
+                return STATUS_TORN, {"version": self._agg.version}
             if tid:
-                TRACE.mark_committed([tid])
-        else:
-            if status == STATUS_FENCED:
+                TRACE.record_span(tid, "decode")
+            result = self._agg.submit(
+                meta["replica_id"], meta["epoch"], params,
+                meta["basis_version"], step=meta["step"],
+                generation=meta["generation"])
+            status = _STATUS_IDS.get(result["status"], STATUS_FENCED)
+            if status == STATUS_APPLIED:
+                self.applied += 1
+                if tid:
+                    TRACE.mark_committed([tid])
+            elif status == STATUS_TIMEOUT:
+                self.barrier_timeouts += 1
+                if tid:
+                    TRACE.terminal_shed(tid)
+            else:
                 self.fenced_submit += 1
+                if tid:
+                    TRACE.terminal_shed(tid)
+            return status, result
+        except Exception as e:
+            # an admitted frame must not vanish from the ledger, and the
+            # span opened above must terminate before the raise escapes
+            # (zero-orphan invariant, exception edge included)
             if tid:
                 TRACE.terminal_shed(tid)
-        return status, result
+            record_event("update_frame_error", error=type(e).__name__)
+            raise
 
     def _serve(self, conn: socket.socket) -> None:
+        try:
+            self._serve_conn(conn)
+        except Exception as e:
+            contained_crash("updates.serve", e)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
         try:
             with conn:
                 if not server_handshake(conn, self._secret):
@@ -267,8 +292,9 @@ class AggregatorServer(ConnRegistry):
     def stats(self) -> dict:
         return {"frames": self.frames, "applied": self.applied,
                 "fenced_header": self.fenced_header,
-                "fenced_submit": self.fenced_submit, "torn": self.torn,
-                "bytes_in": self.bytes_in}
+                "fenced_submit": self.fenced_submit,
+                "barrier_timeouts": self.barrier_timeouts,
+                "torn": self.torn, "bytes_in": self.bytes_in}
 
     def close(self) -> None:
         self._stop.set()

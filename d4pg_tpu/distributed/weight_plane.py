@@ -103,6 +103,7 @@ from d4pg_tpu.distributed.weight_server import (
     _unflatten,
 )
 from d4pg_tpu.distributed.weights import WeightStore
+from d4pg_tpu.obs.containment import contained_crash
 from d4pg_tpu.obs.flight import record_event
 from d4pg_tpu.obs.registry import REGISTRY
 from d4pg_tpu.obs.trace import RECORDER as TRACE, TERMINALS, new_trace_id
@@ -576,6 +577,12 @@ class WeightPlaneServer(WeightServer):
         ids; the conn's NEXT request is the implicit ack (the protocol
         is strictly request/response per conn), and teardown sheds
         whatever is still in flight so no trace can orphan."""
+        try:
+            self._serve_plane_conn(conn)
+        except Exception as e:
+            contained_crash("weights.serve", e)
+
+    def _serve_plane_conn(self, conn) -> None:
         outstanding: list[int] = []
         try:
             with conn:
@@ -923,6 +930,12 @@ class WeightRelay:
         return self._gen  # plain int read; written under _relay_lock
 
     def _poll(self) -> None:
+        try:
+            self._poll_loop()
+        except Exception as e:
+            contained_crash("weights.relay_poll", e)
+
+    def _poll_loop(self) -> None:
         while not self._stop.is_set():
             try:
                 res = self._client.get_if_newer()

@@ -39,6 +39,7 @@ from d4pg_tpu.core.wire import (
     WEIGHTS_V1_RESP as _RESP,
 )
 from d4pg_tpu.distributed.weights import WeightStore
+from d4pg_tpu.obs.containment import contained_crash
 from d4pg_tpu.obs.flight import record_event
 
 
@@ -100,19 +101,24 @@ class WeightServer(ConnRegistry):
         self._thread.start()
 
     def _accept(self) -> None:
-        while not self._stop.is_set():
-            try:
-                self._server.settimeout(0.2)
-                conn, _ = self._server.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                return
-            self._register_conn(conn)
-            self._conn_threads = [t for t in self._conn_threads if t.is_alive()]
-            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
-            self._conn_threads.append(t)
-            t.start()
+        try:
+            while not self._stop.is_set():
+                try:
+                    self._server.settimeout(0.2)
+                    conn, _ = self._server.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                self._register_conn(conn)
+                self._conn_threads = [t for t in self._conn_threads
+                                      if t.is_alive()]
+                t = threading.Thread(target=self._serve, args=(conn,),
+                                     daemon=True)
+                self._conn_threads.append(t)
+                t.start()
+        except Exception as e:
+            contained_crash("weights.accept", e)
 
     def _legacy_frame(self, have: int) -> bytes | None:
         """The memoized v1 response body for a puller at ``have``: None
@@ -153,6 +159,12 @@ class WeightServer(ConnRegistry):
             return payload
 
     def _serve(self, conn: socket.socket) -> None:
+        try:
+            self._serve_conn(conn)
+        except Exception as e:
+            contained_crash("weights.serve", e)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
         try:
             with conn:
                 if not server_handshake(conn, self._secret):

@@ -36,6 +36,7 @@ from d4pg_tpu.distributed.transport import TransitionReceiver
 from d4pg_tpu.fleet.chaos import ChaosConfig, ChaosPolicy, StallGate
 from d4pg_tpu.fleet.sender import ThrottledSender, synthetic_block
 from d4pg_tpu.obs import flight as obs_flight
+from d4pg_tpu.obs.containment import contained_crash
 from d4pg_tpu.obs import trace as obs_trace
 from d4pg_tpu.obs.registry import REGISTRY
 from d4pg_tpu.replay.uniform import ReplayBuffer
@@ -246,15 +247,18 @@ class FleetHarness:
         batch = min(64, cfg.block_rows * 4)
 
         def consume():
-            while not stop.is_set():
-                service = service_ref()
-                if len(service) >= batch:
-                    try:
-                        service.sample(batch)
-                    except (ValueError, RuntimeError):
-                        pass  # raced an empty buffer or a dying service
-                    obs_trace.RECORDER.mark_grad()
-                stop.wait(period)
+            try:
+                while not stop.is_set():
+                    service = service_ref()
+                    if len(service) >= batch:
+                        try:
+                            service.sample(batch)
+                        except (ValueError, RuntimeError):
+                            pass  # raced an empty buffer or a dying service
+                        obs_trace.RECORDER.mark_grad()
+                    stop.wait(period)
+            except Exception as e:  # noqa: BLE001 — top frame of the lane
+                contained_crash("fleet.consumer", e)
 
         t = threading.Thread(target=consume, daemon=True,
                              name="fleet-consumer")
@@ -376,7 +380,10 @@ class FleetHarness:
             for i in range(cfg.n_actors)
         ]
         threads = [
-            threading.Thread(target=lane.run, daemon=True,
+            # lane.run is an instance-attribute target the static graph
+            # can't resolve; ThrottledSender.run owns the lane's top-frame
+            # broad handler and counts the crash.
+            threading.Thread(target=lane.run, daemon=True,  # jaxlint: contained-by=ThrottledSender.run
                              name=f"fleet-lane-{i}")
             for i, lane in enumerate(lanes)
         ]
@@ -385,19 +392,22 @@ class FleetHarness:
 
         def monitor():
             # periodic heartbeat eviction + the seeded receiver-stall script
-            horizon = cfg.duration_s if cfg.max_ticks is None else 3600.0
-            stalls = list(self.policy.stall_schedule(horizon))
-            t0 = time.monotonic()
-            while not monitor_stop.is_set():
-                holder["svc"].evict_dead()
-                now = time.monotonic() - t0
-                if stalls and now >= stalls[0][0]:
-                    _, dur = stalls.pop(0)
-                    obs_flight.record_event("receiver_stall", dur_s=dur)
-                    gate.stall()
-                    monitor_stop.wait(dur)
-                    gate.resume()
-                monitor_stop.wait(cfg.evict_every_s)
+            try:
+                horizon = cfg.duration_s if cfg.max_ticks is None else 3600.0
+                stalls = list(self.policy.stall_schedule(horizon))
+                t0 = time.monotonic()
+                while not monitor_stop.is_set():
+                    holder["svc"].evict_dead()
+                    now = time.monotonic() - t0
+                    if stalls and now >= stalls[0][0]:
+                        _, dur = stalls.pop(0)
+                        obs_flight.record_event("receiver_stall", dur_s=dur)
+                        gate.stall()
+                        monitor_stop.wait(dur)
+                        gate.resume()
+                    monitor_stop.wait(cfg.evict_every_s)
+            except Exception as e:  # noqa: BLE001 — top frame of the lane
+                contained_crash("fleet.monitor", e)
 
         monitor_thread = threading.Thread(target=monitor, daemon=True)
 
@@ -481,6 +491,13 @@ class FleetHarness:
         declared crash loss, frames from the dead generation fence at
         admission, and MTTR is kill → first row committed by the
         restored incarnation."""
+        try:
+            self._supervise_run(holder, gate, gen_ref, stop_ev, recovery)
+        except Exception as e:  # noqa: BLE001 — top frame of the lane
+            contained_crash("fleet.supervisor", e)
+
+    def _supervise_run(self, holder: dict, gate: StallGate, gen_ref,
+                       stop_ev: threading.Event, recovery: dict) -> None:
         cfg = self.config
         ch = cfg.chaos
         horizon = cfg.duration_s if cfg.max_ticks is None else 3600.0

@@ -51,6 +51,7 @@ from d4pg_tpu.core import locking
 from d4pg_tpu.distributed.update_plane import AggregatorServer, UpdateClient
 from d4pg_tpu.distributed.weights import WeightStore
 from d4pg_tpu.learner.aggregator import Aggregator
+from d4pg_tpu.obs.containment import contained_crash
 from d4pg_tpu.obs.flight import record_event
 from d4pg_tpu.obs.registry import percentile_summary
 from d4pg_tpu.obs.trace import RECORDER as TRACE
@@ -147,10 +148,13 @@ class _ReplicaLane:
             self.lags.append(res["lag"])
 
     def _run(self) -> None:
-        interval = 1.0 / self._cfg.submit_hz
-        while not self._stop.is_set():
-            self.submit_once()
-            self._stop.wait(interval)
+        try:
+            interval = 1.0 / self._cfg.submit_hz
+            while not self._stop.is_set():
+                self.submit_once()
+                self._stop.wait(interval)
+        except Exception as e:  # noqa: BLE001 — top frame of the lane
+            contained_crash("chaos.learner_lane", e)
 
     def stop(self) -> None:
         self._stop.set()

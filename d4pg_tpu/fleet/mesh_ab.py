@@ -37,6 +37,7 @@ import time
 
 import numpy as np
 
+from d4pg_tpu.obs.containment import contained_crash
 from d4pg_tpu.obs.registry import percentile_summary
 
 
@@ -129,7 +130,13 @@ def _run_socket_arm(cfg: MeshABConfig, config) -> dict:
     agg_lat: list[float] = []
 
     def _fanout(fn) -> None:
-        threads = [threading.Thread(target=fn, args=(i,), daemon=True)
+        def _runner(i: int) -> None:
+            try:
+                fn(i)
+            except Exception as e:  # noqa: BLE001 — top frame of the lane
+                contained_crash("mesh_ab.replica", e)
+
+        threads = [threading.Thread(target=_runner, args=(i,), daemon=True)
                    for i in range(n)]
         for t in threads:
             t.start()

@@ -49,6 +49,7 @@ from d4pg_tpu.distributed.replay_service import ReplayService
 from d4pg_tpu.fleet.chaos import ChaosConfig
 from d4pg_tpu.fleet.harness import FleetConfig, FleetHarness
 from d4pg_tpu.fleet.sender import synthetic_block
+from d4pg_tpu.obs.containment import contained_crash
 from d4pg_tpu.obs.flight import record_event
 from d4pg_tpu.obs.trace import RECORDER as TRACE
 from d4pg_tpu.replay.prioritized import PrioritizedReplayBuffer
@@ -175,6 +176,13 @@ class _SamplerHarness(FleetHarness):
     def _consume_supervise(self, service_ref, stop: threading.Event) -> None:
         """Run the consumer thread, killing + respawning it on the seeded
         schedule, and inject the stale-generation frames."""
+        try:
+            self._supervise_consumers(service_ref, stop)
+        except Exception as e:  # noqa: BLE001 — top frame of the lane
+            contained_crash("chaos.sampler_supervisor", e)
+
+    def _supervise_consumers(self, service_ref,
+                             stop: threading.Event) -> None:
         scfg = self.scfg
         kills = scfg.kill_schedule()
         stales = scfg.stale_schedule()
@@ -232,6 +240,12 @@ class _SamplerHarness(FleetHarness):
         the A/B arms model the SAME per-block grad time — what differs
         is only how the block is obtained (an unpaced pop loop would
         compare a zero-grad-time learner against a 200 Hz one)."""
+        try:
+            self._consume_dealt_loop(service_ref, stop, inner_stop)
+        except Exception as e:  # noqa: BLE001 — top frame of the lane
+            contained_crash("chaos.sampler_dealt", e)
+
+    def _consume_dealt_loop(self, service_ref, stop, inner_stop) -> None:
         scfg = self.scfg
         rng = np.random.default_rng(np.random.SeedSequence(
             scfg.seed, spawn_key=(0xD4B1, self.cstats["consumer_kills"])))
@@ -256,6 +270,12 @@ class _SamplerHarness(FleetHarness):
         """The PR-10 lane: every consumed block is weight_base +
         sample_chunk + update_priorities — three buffer-lock
         acquisitions, counted."""
+        try:
+            self._consume_host_loop(service_ref, stop, inner_stop)
+        except Exception as e:  # noqa: BLE001 — top frame of the lane
+            contained_crash("chaos.sampler_host", e)
+
+    def _consume_host_loop(self, service_ref, stop, inner_stop) -> None:
         scfg = self.scfg
         rng = np.random.default_rng(np.random.SeedSequence(
             scfg.seed, spawn_key=(0xD4B2, self.cstats["consumer_kills"])))

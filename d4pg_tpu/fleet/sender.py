@@ -31,6 +31,7 @@ from d4pg_tpu.distributed.transport import (
     ReconnectingClient,
 )
 from d4pg_tpu.fleet.chaos import ActorChaos
+from d4pg_tpu.obs.containment import contained_crash
 from d4pg_tpu.replay.uniform import TransitionBatch
 
 
@@ -155,6 +156,12 @@ class ThrottledSender:
 
     # -- the lane loop -----------------------------------------------------
     def run(self) -> None:
+        try:
+            self._run_lane()
+        except Exception as e:  # noqa: BLE001 — top frame of the lane
+            contained_crash("fleet.sender", e)
+
+    def _run_lane(self) -> None:
         self._sleep(self._connect_stagger_s)  # de-synchronize the storm
         sender = self._reconnect()
         next_t = time.monotonic()

@@ -52,6 +52,7 @@ from d4pg_tpu.distributed.weights import WeightStore
 from d4pg_tpu.envs.fake import PointMassEnv
 from d4pg_tpu.envs.vector import EnvPool
 from d4pg_tpu.learner.state import D4PGConfig, init_state
+from d4pg_tpu.obs.containment import contained_crash
 from d4pg_tpu.obs.flight import record_event
 from d4pg_tpu.obs.registry import percentile_summary
 from d4pg_tpu.obs.trace import RECORDER as TRACE
@@ -140,10 +141,13 @@ class _ParamPublisher:
             self.publishes += 1
 
     def _run(self) -> None:
-        interval = 1.0 / self._hz
-        while not self._stop.is_set():
-            self.publish_once()
-            self._stop.wait(interval)
+        try:
+            interval = 1.0 / self._hz
+            while not self._stop.is_set():
+                self.publish_once()
+                self._stop.wait(interval)
+        except Exception as e:  # noqa: BLE001 — top frame of the lane
+            contained_crash("chaos.param_publisher", e)
 
     def close(self) -> None:
         self._stop.set()
@@ -202,7 +206,10 @@ class _Lane:
 
     def _run(self) -> None:
         # one huge budget; the lane's own stop event breaks the loop
-        self.lane.run(1 << 30)
+        try:
+            self.lane.run(1 << 30)
+        except Exception as e:  # noqa: BLE001 — top frame of the lane
+            contained_crash("chaos.serving_lane", e)
 
     def stop(self) -> None:
         self.lane.stop()

@@ -55,6 +55,7 @@ from d4pg_tpu.distributed.weight_plane import (
     WeightWireChaos,
 )
 from d4pg_tpu.distributed.weights import WeightStore
+from d4pg_tpu.obs.containment import contained_crash
 from d4pg_tpu.obs.flight import record_event
 from d4pg_tpu.obs.registry import percentile_summary
 from d4pg_tpu.obs.trace import RECORDER as TRACE
@@ -146,10 +147,13 @@ class _Publisher:
             self.store = store
 
     def _run(self) -> None:
-        interval = 1.0 / self._cfg.publish_hz
-        while not self._stop.is_set():
-            self.publish_once()
-            self._stop.wait(interval)
+        try:
+            interval = 1.0 / self._cfg.publish_hz
+            while not self._stop.is_set():
+                self.publish_once()
+                self._stop.wait(interval)
+        except Exception as e:  # noqa: BLE001 — top frame of the lane
+            contained_crash("chaos.weight_publisher", e)
 
     def close(self) -> None:
         self._stop.set()
@@ -175,10 +179,13 @@ class _Puller:
         self._thread.start()
 
     def _run(self) -> None:
-        interval = 1.0 / self._cfg.pull_hz
-        while not self._stop.is_set():
-            self.pull_once()
-            self._stop.wait(interval)
+        try:
+            interval = 1.0 / self._cfg.pull_hz
+            while not self._stop.is_set():
+                self.pull_once()
+                self._stop.wait(interval)
+        except Exception as e:  # noqa: BLE001 — top frame of the lane
+            contained_crash("chaos.weight_puller", e)
 
     def pull_once(self) -> bool:
         try:

@@ -10,16 +10,51 @@ for concurrency-touching PRs; exit 1 iff the graph has a cycle.
 ``--wire`` prints the discovered wire-protocol registry (magics, owning
 planes, pack/unpack witness sites, flag-bit map) — the review artifact
 for protocol-touching PRs; exit 1 iff any wire family fires.
+
+``--fail`` prints the thread-role/containment/span-lifecycle graph from
+the exception-flow pass (families 16-18) — the review artifact for
+thread- or obs-touching PRs; exit 1 iff any fail family fires.
+
+``--json`` switches any of the four modes to a machine-readable document
+on stdout: ``{"schema": 1, "mode": ..., "findings": [...], ...}`` — the
+contract tests/test_lint_clean.py gates so CI tooling never scrapes the
+human-oriented text.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
-from d4pg_tpu.lint.engine import build_lock_graph, build_wire_graph, lint_paths
+from d4pg_tpu.lint.engine import (
+    build_fail_graph,
+    build_lock_graph,
+    build_wire_graph,
+    lint_paths,
+)
 from d4pg_tpu.lint.rules import RULES
+
+JSON_SCHEMA_VERSION = 1
+
+
+def _magic_key(m) -> str:
+    # magics are u16 ints except the ASCII resync sentinel (bytes)
+    return f"0x{m:04X}" if isinstance(m, int) else m.decode("ascii")
+
+
+def _finding_doc(f) -> dict:
+    return {"file": f.file, "line": f.line, "col": f.col, "rule": f.rule,
+            "message": f.message, "suppressed": f.suppressed}
+
+
+def _doc(mode: str, findings, errors, **extra) -> dict:
+    doc = {"schema": JSON_SCHEMA_VERSION, "mode": mode,
+           "findings": [_finding_doc(f) for f in findings],
+           "errors": list(errors)}
+    doc.update(extra)
+    return doc
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -44,6 +79,14 @@ def main(argv: list[str] | None = None) -> int:
                              "(magics, pack/unpack witnesses, flag bits) "
                              "instead of findings; exit 1 iff any wire "
                              "family fires")
+    parser.add_argument("--fail", action="store_true",
+                        help="print the thread-role/containment/"
+                             "span-lifecycle graph (families 16-18) "
+                             "instead of findings; exit 1 iff any fail "
+                             "family fires")
+    parser.add_argument("--json", action="store_true",
+                        help="emit a machine-readable document instead of "
+                             "the human-oriented text (all modes)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -51,26 +94,68 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{rule.id:22s} {rule.summary}")
         return 0
 
+    paths = args.paths or [os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))]
+
     if args.locks:
         from d4pg_tpu.lint.lockgraph import format_graph
 
-        paths = args.paths or [os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)))]
         graph, errors = build_lock_graph(paths)
-        print(format_graph(graph))
-        for e in errors:
-            print(e, file=sys.stderr)
+        if args.json:
+            print(json.dumps(_doc(
+                "locks", graph.findings, errors,
+                functions=graph.functions,
+                nodes={n: t for n, t in sorted(graph.nodes.items())},
+                edges=[{"held": a, "acquired": b, "witnesses": w}
+                       for (a, b), w in sorted(graph.edges.items())],
+                cycles=graph.cycles), indent=2))
+        else:
+            print(format_graph(graph))
+            for e in errors:
+                print(e, file=sys.stderr)
         return 1 if graph.cycles else 0
 
     if args.wire:
         from d4pg_tpu.lint.wiregraph import format_registry
 
-        paths = args.paths or [os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)))]
         graph, errors = build_wire_graph(paths)
-        print(format_registry(graph))
-        for e in errors:
-            print(e, file=sys.stderr)
+        if args.json:
+            print(json.dumps(_doc(
+                "wire", graph.findings, errors,
+                functions=graph.functions, modules=graph.modules,
+                magics={_magic_key(m): info
+                        for m, info in sorted(graph.magics.items(),
+                                              key=lambda kv:
+                                              _magic_key(kv[0]))},
+                flags={plane: {str(bit): meaning
+                               for bit, meaning in sorted(bits.items())}
+                       for plane, bits in sorted(graph.flags.items())}),
+                indent=2))
+        else:
+            print(format_registry(graph))
+            for e in errors:
+                print(e, file=sys.stderr)
+        return 1 if graph.findings else 0
+
+    if args.fail:
+        from d4pg_tpu.lint.failgraph import format_failgraph
+
+        graph, errors = build_fail_graph(paths)
+        if args.json:
+            print(json.dumps(_doc(
+                "fail", graph.findings, errors,
+                functions=graph.functions, modules=graph.modules,
+                threads=[{"site": s, "target": t, "status": st}
+                         for s, t, st in sorted(graph.threads)],
+                spans=[{"site": s, "root": r, "status": st}
+                       for s, r, st in sorted(graph.spans)],
+                ledger=[{"site": s, "counter": c, "status": st}
+                        for s, c, st in sorted(graph.ledger)],
+                handlers=dict(sorted(graph.handlers.items()))), indent=2))
+        else:
+            print(format_failgraph(graph))
+            for e in errors:
+                print(e, file=sys.stderr)
         return 1 if graph.findings else 0
 
     rules = None
@@ -81,9 +166,16 @@ def main(argv: list[str] | None = None) -> int:
             print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
             return 2
 
-    paths = args.paths or [os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))]
     result = lint_paths(paths, rules=rules)
+
+    if args.json:
+        shown = list(result.findings)
+        if args.show_suppressed:
+            shown += result.suppressed
+        print(json.dumps(_doc(
+            "findings", shown, result.errors,
+            suppressed=len(result.suppressed)), indent=2))
+        return 0 if result.clean else 1
 
     for f in result.findings:
         print(f.format())

@@ -69,10 +69,13 @@ def _run_program_rules(ctxs: list[ModuleContext], program_ids: list[str],
                        result: LintResult) -> None:
     if not program_ids or not ctxs:
         return
+    from d4pg_tpu.lint.failgraph import FAIL_RULES
     from d4pg_tpu.lint.wiregraph import WIRE_RULES
 
-    lock_ids = [r for r in program_ids if r not in WIRE_RULES]
+    lock_ids = [r for r in program_ids
+                if r not in WIRE_RULES and r not in FAIL_RULES]
     wire_ids = [r for r in program_ids if r in WIRE_RULES]
+    fail_ids = [r for r in program_ids if r in FAIL_RULES]
     per_file: dict[str, list[Finding]] = {}
     if lock_ids:
         from d4pg_tpu.lint import lockgraph
@@ -83,6 +86,11 @@ def _run_program_rules(ctxs: list[ModuleContext], program_ids: list[str],
         from d4pg_tpu.lint import wiregraph
 
         for f in wiregraph.analyze(ctxs, rules=wire_ids).findings:
+            per_file.setdefault(f.file, []).append(f)
+    if fail_ids:
+        from d4pg_tpu.lint import failgraph
+
+        for f in failgraph.analyze(ctxs, rules=fail_ids).findings:
             per_file.setdefault(f.file, []).append(f)
     for path, found in sorted(per_file.items()):
         _sift(found, sups.get(path, Suppressions()), result)
@@ -170,4 +178,23 @@ def build_wire_graph(paths: list[str]):
         except (OSError, SyntaxError) as e:
             errors.append(f"{path}: {e}")
     graph = wiregraph.analyze(ctxs)
+    return graph, errors
+
+
+def build_fail_graph(paths: list[str]):
+    """The ``--fail`` review artifact: thread roles with containment
+    status, span lifecycle sites, and the admission-counter ledger over
+    ``paths`` (plus findings from families 16-18)."""
+    from d4pg_tpu.lint import failgraph
+
+    ctxs: list[ModuleContext] = []
+    errors: list[str] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            ctxs.append(build_context(path, source))
+        except (OSError, SyntaxError) as e:
+            errors.append(f"{path}: {e}")
+    graph = failgraph.analyze(ctxs)
     return graph, errors

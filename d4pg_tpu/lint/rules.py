@@ -1,6 +1,6 @@
 """The jaxlint rule catalog.
 
-Fifteen rule families, each targeting a hazard that silently costs
+Eighteen rule families, each targeting a hazard that silently costs
 throughput or correctness on this stack (see docs/architecture.md "Static
 analysis & perf sentinels" for the rationale and suppression policy):
 
@@ -20,11 +20,19 @@ analysis & perf sentinels" for the rationale and suppression policy):
 - ``codec-asymmetry``      — pack/unpack format or field-count drift
 - ``unchecked-frame``      — recv-rooted decode without error/crc containment
 - ``flag-bit-collision``   — one flag-byte bit claimed by two extensions
+- ``thread-crash-containment`` — Thread target that can die uncaught (or
+  caught-but-uncounted); ``# jaxlint: contained-by=<handler>`` declares
+  an audited wrapper
+- ``span-terminal-missing`` — trace begin with an exception-edge path to
+  exit that never reaches a commit/shed terminal
+- ``ledger-conservation``  — admission-counter bump whose path to exit
+  records no disposition and no hand-off
 
-The last six are PROGRAM-scope families implemented in
-``lint/lockgraph.py`` (locks) and ``lint/wiregraph.py`` (wire protocol):
-they analyze every module of a lint run together (cross-module call
-graph), where everything above is per-module.
+The last nine are PROGRAM-scope families implemented in
+``lint/lockgraph.py`` (locks), ``lint/wiregraph.py`` (wire protocol) and
+``lint/failgraph.py`` (exception flow / ledger): they analyze every
+module of a lint run together (cross-module call graph), where
+everything above is per-module.
 
 Every rule is a function ``(ModuleContext) -> list[Finding]`` registered in
 ``RULES``. Rules are deliberately conservative: a finding should be either
@@ -871,6 +879,17 @@ def _wire_rule(rule_id: str):
     return check
 
 
+def _fail_rule(rule_id: str):
+    """Same single-module fallback for the exception-flow families
+    (``lint/failgraph.py``)."""
+    def check(ctx: ModuleContext) -> list[Finding]:
+        from d4pg_tpu.lint import failgraph
+
+        return failgraph.analyze([ctx], rules=[rule_id]).findings
+
+    return check
+
+
 RULES: dict[str, Rule] = {r.id: r for r in [
     Rule("prng-key-reuse",
          "same PRNG key consumed by two jax.random samplers without an "
@@ -936,4 +955,17 @@ RULES: dict[str, Rule] = {r.id: r for r in [
          "two extensions claiming the same bit of the same plane's flag "
          "byte — see core/wire.py for the allocations",
          _wire_rule("flag-bit-collision"), scope="program"),
+    Rule("thread-crash-containment",
+         "threading.Thread target that can die on an uncaught raise, or "
+         "whose broad handler swallows the crash uncounted — declare "
+         "`# jaxlint: contained-by=<handler>` for wrapped targets",
+         _fail_rule("thread-crash-containment"), scope="program"),
+    Rule("span-terminal-missing",
+         "trace begin whose exception edges can exit the frame without a "
+         "commit/shed terminal — the static zero-orphan invariant",
+         _fail_rule("span-terminal-missing"), scope="program"),
+    Rule("ledger-conservation",
+         "frame-admission counter bump with a path to exit that records "
+         "neither a disposition counter nor a terminal hand-off",
+         _fail_rule("ledger-conservation"), scope="program"),
 ]}

@@ -1,7 +1,7 @@
 """Observability plane: wire-to-grad trace spans, the unified metrics
 registry, and the chaos flight recorder.
 
-Three stdlib-only modules (nothing here may import jax — the plane must
+Four stdlib-only modules (nothing here may import jax — the plane must
 be importable from the transport/locking layers that run before any
 backend exists):
 
@@ -22,6 +22,9 @@ backend exists):
   violations, retries) the fleet harness dumps to
   ``docs/evidence/fleet/`` on deadlock, crash or assertion, so a chaos
   failure comes with a postmortem instead of a stack trace.
+- ``obs.containment`` — the one-call crash-containment breadcrumb every
+  thread role's top frame uses (``threads.contained_crashes`` counter +
+  a flight event); jaxlint family 16 enforces its presence statically.
 
 Lock discipline: every lock in this package is named ``_mu`` — a plain
 ``threading.Lock`` OUTSIDE the tiered hierarchy, deliberately terminal:
@@ -30,14 +33,15 @@ observability plane can be called from under any tiered lock without
 adding an edge the lock graph could cycle through.
 """
 
-from d4pg_tpu.obs import flight, registry, trace
+from d4pg_tpu.obs import containment, flight, registry, trace
+from d4pg_tpu.obs.containment import contained_crash
 from d4pg_tpu.obs.flight import FlightRecorder, record_event
 from d4pg_tpu.obs.registry import REGISTRY, MetricsRegistry
 from d4pg_tpu.obs.trace import DEFAULT_SAMPLE, TraceRecorder
 
 __all__ = [
-    "flight", "registry", "trace",
-    "FlightRecorder", "record_event",
+    "containment", "flight", "registry", "trace",
+    "FlightRecorder", "record_event", "contained_crash",
     "REGISTRY", "MetricsRegistry",
     "DEFAULT_SAMPLE", "TraceRecorder",
 ]

@@ -1,0 +1,24 @@
+"""Top-frame crash containment for long-lived thread roles.
+
+Every ``threading.Thread`` target in the five wire planes wraps its body
+in a broad handler that calls :func:`contained_crash` — the thread dies,
+but the death is *counted* (``threads.contained_crashes`` registry
+counter) and *flight-recorded* (a ``thread_crash_contained`` event with
+the role name and the exception), so a silently-dead plane shows up in
+the next metrics snapshot instead of as a mystery stall.  The static
+side of the contract is jaxlint family 16 (``thread-crash-containment``
+in ``lint/failgraph.py``); the runtime side is the chaos smokes
+asserting the counter stayed at zero across a healthy run.
+"""
+
+from __future__ import annotations
+
+from d4pg_tpu.obs.flight import record_event
+from d4pg_tpu.obs.registry import REGISTRY
+
+
+def contained_crash(role: str, exc: BaseException) -> None:
+    """Count and flight-record a thread-top-frame crash for ``role``."""
+    REGISTRY.counter("threads.contained_crashes").inc()
+    record_event("thread_crash_contained", role=role,
+                 error=f"{type(exc).__name__}: {exc}")

@@ -50,6 +50,7 @@ import numpy as np
 from d4pg_tpu.core.locking import TieredCondition
 from d4pg_tpu.learner.state import D4PGConfig
 from d4pg_tpu.learner.update import act_deterministic
+from d4pg_tpu.obs.containment import contained_crash
 from d4pg_tpu.obs.flight import record_event
 from d4pg_tpu.obs.registry import REGISTRY, percentile_summary
 from d4pg_tpu.obs.trace import RECORDER
@@ -164,9 +165,12 @@ class PolicyInferenceServer(ConnRegistry):
 
     # -- param freshness ----------------------------------------------------
     def _refresher(self) -> None:
-        while not self._stop.is_set():
-            self.refresh_once()
-            self._stop.wait(self.refresh_interval_s)
+        try:
+            while not self._stop.is_set():
+                self.refresh_once()
+                self._stop.wait(self.refresh_interval_s)
+        except Exception as e:
+            contained_crash("serving.refresher", e)
 
     def refresh_once(self) -> bool:
         """One adoption attempt against the store's current snapshot.
@@ -211,24 +215,33 @@ class PolicyInferenceServer(ConnRegistry):
 
     # -- connections --------------------------------------------------------
     def _accept(self) -> None:
-        while not self._stop.is_set():
-            try:
-                self._server.settimeout(0.2)
-                conn, _ = self._server.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                return
-            self._register_conn(conn)
-            self._conn_threads = [t for t in self._conn_threads
-                                  if t.is_alive()]
-            t = threading.Thread(target=self._reader, args=(conn,),
-                                 daemon=True)
-            self._conn_threads.append(t)
-            t.start()
+        try:
+            while not self._stop.is_set():
+                try:
+                    self._server.settimeout(0.2)
+                    conn, _ = self._server.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                self._register_conn(conn)
+                self._conn_threads = [t for t in self._conn_threads
+                                      if t.is_alive()]
+                t = threading.Thread(target=self._reader, args=(conn,),
+                                     daemon=True)
+                self._conn_threads.append(t)
+                t.start()
+        except Exception as e:
+            contained_crash("serving.accept", e)
 
     def _reader(self, conn: socket.socket) -> None:
         """Per-connection request pump: decode, validate, enqueue."""
+        try:
+            self._read_conn(conn)
+        except Exception as e:
+            contained_crash("serving.reader", e)
+
+    def _read_conn(self, conn: socket.socket) -> None:
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             if not server_handshake(conn, self._secret):
@@ -251,15 +264,7 @@ class PolicyInferenceServer(ConnRegistry):
                     self._respond_error(conn, req["req_id"],
                                         protocol.STATUS_BAD_REQUEST)
                     continue
-                now = time.monotonic()
-                if req["trace"] is not None:
-                    tid, birth = req["trace"]
-                    RECORDER.begin(tid, birth)
-                    RECORDER.record_span(tid, "admission", now)
-                with self._pserve_cond:
-                    self.stats["requests"] += 1
-                    self._pending.append((conn, req, now))
-                    self._pserve_cond.notify()
+                self._admit_request(conn, req)
         except (OSError, protocol.ProtocolError):
             return  # peer died or desynced; the lane reconnects
         finally:
@@ -268,6 +273,28 @@ class PolicyInferenceServer(ConnRegistry):
                 conn.close()
             except OSError:
                 pass
+
+    def _admit_request(self, conn: socket.socket, req: dict) -> None:
+        """Admit one decoded request into the pending queue, opening its
+        trace span; custody of the span rides the queue entry from here
+        (the batcher's response path commits or sheds it)."""
+        now = time.monotonic()
+        tid = None
+        if req["trace"] is not None:
+            tid, birth = req["trace"]
+            RECORDER.begin(tid, birth)
+            RECORDER.record_span(tid, "admission", now)
+        try:
+            with self._pserve_cond:
+                self.stats["requests"] += 1
+                self._pending.append((conn, req, now))
+                self._pserve_cond.notify()
+        except BaseException:
+            # zero-orphan invariant: a failed enqueue sheds the span it
+            # just opened before the raise escapes the frame
+            if tid is not None:
+                RECORDER.terminal_shed(tid)
+            raise
 
     def _respond_error(self, conn: socket.socket, req_id: int,
                        status: int) -> None:
@@ -293,6 +320,12 @@ class PolicyInferenceServer(ConnRegistry):
         return batch
 
     def _batcher(self) -> None:
+        try:
+            self._batch_loop()
+        except Exception as e:
+            contained_crash("serving.batcher", e)
+
+    def _batch_loop(self) -> None:
         while True:
             with self._pserve_cond:
                 while not self._pending and not self._stop.is_set():

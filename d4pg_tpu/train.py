@@ -44,6 +44,7 @@ from d4pg_tpu.envs import (
     get_preset,
 )
 from d4pg_tpu.io import CheckpointManager, CsvLogger, MetricsBus, TensorBoardSink
+from d4pg_tpu.obs.containment import contained_crash
 from d4pg_tpu.io.profiling import StepTimer, xla_trace
 from d4pg_tpu.learner import init_state, make_multi_update, make_update
 from d4pg_tpu.learner.loop import FusedLoop
@@ -1129,8 +1130,9 @@ def train(cfg: ExperimentConfig) -> dict:
         def run_replica(r):
             try:
                 r.run_round(per)
-            except Exception:  # noqa: BLE001 — supervisor owns the verdict
+            except Exception as e:  # noqa: BLE001 — supervisor owns the verdict
                 failed[r.replica_id] = traceback.format_exc()
+                contained_crash(f"learner.replica{r.replica_id}", e)
 
         threads = [
             threading.Thread(target=run_replica, args=(r,), daemon=True)
@@ -1225,12 +1227,13 @@ def train(cfg: ExperimentConfig) -> dict:
                     actor.run_episode(cfg.max_steps)
                 else:
                     actor.run(50)
-        except Exception:  # noqa: BLE001 — actor crash must not kill training
+        except Exception as e:  # noqa: BLE001 — actor crash must not kill training
             # Log and EXIT the thread; the once-per-cycle supervisor
             # respawns it, which also rate-limits a permanently failing
             # actor to one attempt per cycle.
             print(f"actor {actor.actor_id} crashed:\n{traceback.format_exc()}",
                   flush=True)
+            contained_crash(f"actor.{actor.actor_id}", e)
 
     def start_actor_thread(i: int):
         t = threading.Thread(target=actor_loop, args=(actors[i],), daemon=True)

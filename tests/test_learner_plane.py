@@ -430,9 +430,15 @@ def test_learner_chaos_smoke():
         run_learner_chaos,
     )
 
+    from d4pg_tpu.obs.registry import REGISTRY
+
+    crashes0 = REGISTRY.counter("threads.contained_crashes").value
     rep = run_learner_chaos(LearnerChaosConfig(
         n_replicas=2, duration_s=1.5, replica_kills=1, seed=3))
     assert rep["replica_kills"] == 1
+    # chaos is injected through narrow, expected-error paths; the broad
+    # top-frame containments must never fire during a clean run
+    assert REGISTRY.counter("threads.contained_crashes").value == crashes0
     assert rep["replayed_fenced"] == rep["replayed_inflight"]
     assert rep["updates_applied"] > 0 and rep["updates_per_sec"] > 0
     assert rep["torn"]["detected"] == rep["torn"]["injected"]

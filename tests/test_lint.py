@@ -305,6 +305,8 @@ def test_rule_catalog_covers_all_families():
         "host-time-in-jit", "lock-order", "sharding-rule-bypass",
         "lock-cycle", "unguarded-shared-write", "wire-magic-registry",
         "codec-asymmetry", "unchecked-frame", "flag-bit-collision",
+        "thread-crash-containment", "span-terminal-missing",
+        "ledger-conservation",
     }
     assert RULES["sharding-rule-bypass"].scope == "module"
     # the lock-graph and wire-graph families analyze whole programs,
@@ -312,7 +314,9 @@ def test_rule_catalog_covers_all_families():
     assert RULES["lock-cycle"].scope == "program"
     assert RULES["unguarded-shared-write"].scope == "program"
     for rule in ("wire-magic-registry", "codec-asymmetry",
-                 "unchecked-frame", "flag-bit-collision"):
+                 "unchecked-frame", "flag-bit-collision",
+                 "thread-crash-containment", "span-terminal-missing",
+                 "ledger-conservation"):
         assert RULES[rule].scope == "program"
     assert RULES["lock-order"].scope == "module"
 
@@ -1107,3 +1111,318 @@ def test_wire_cli_mode(tmp_path, capsys):
     assert lint_main(["--wire", str(good)]) == 0
     out = capsys.readouterr().out
     assert "0xD4FA" in out and "findings: none" in out
+
+
+# ----------------------------------------- R16-R18 (failgraph) ------------
+
+@pytest.mark.failflow
+def test_thread_containment_fires_on_escaping_target():
+    out = findings("""
+        import threading
+
+        class Plane:
+            def start(self):
+                self._t = threading.Thread(target=self._serve, daemon=True)
+                self._t.start()
+
+            def _serve(self):
+                while True:
+                    self.handle_one()
+        """, "thread-crash-containment")
+    assert len(out) == 1
+    assert "die silently" in out[0].message
+
+
+@pytest.mark.failflow
+def test_thread_containment_clean_on_caught_and_counted():
+    out = findings("""
+        import threading
+
+        class Plane:
+            def start(self):
+                self._t = threading.Thread(target=self._serve, daemon=True)
+                self._t.start()
+
+            def _serve(self):
+                try:
+                    while True:
+                        self.handle_one()
+                except Exception:
+                    self.contained_crashes += 1
+        """, "thread-crash-containment")
+    assert out == []
+
+
+@pytest.mark.failflow
+def test_thread_containment_fires_on_uncounted_handler():
+    out = findings("""
+        import threading
+
+        class Plane:
+            def start(self):
+                self._t = threading.Thread(target=self._serve, daemon=True)
+                self._t.start()
+
+            def _serve(self):
+                try:
+                    while True:
+                        self.handle_one()
+                except Exception:
+                    pass
+        """, "thread-crash-containment")
+    assert len(out) == 1
+    assert "without counting" in out[0].message
+
+
+@pytest.mark.failflow
+def test_thread_containment_fires_on_reraising_handler():
+    out = findings("""
+        import threading
+
+        class Plane:
+            def start(self):
+                self._t = threading.Thread(target=self._serve, daemon=True)
+                self._t.start()
+
+            def _serve(self):
+                try:
+                    while True:
+                        self.handle_one()
+                except Exception:
+                    self.contained_crashes += 1
+                    raise
+        """, "thread-crash-containment")
+    assert len(out) == 1
+    assert "die silently" in out[0].message
+
+
+@pytest.mark.failflow
+def test_thread_containment_fires_on_unresolvable_target():
+    out = findings("""
+        import threading
+
+        def launch(lanes):
+            for lane in lanes:
+                t = threading.Thread(target=lane.run, daemon=True)
+                t.start()
+        """, "thread-crash-containment")
+    assert len(out) == 1
+    assert "does not resolve" in out[0].message
+
+
+@pytest.mark.failflow
+def test_thread_containment_contained_by_declaration_satisfies():
+    out = findings("""
+        import threading
+
+        class Lane:
+            def run(self):
+                try:
+                    self.spin()
+                except Exception:
+                    self.crashes += 1
+
+        def launch(lanes):
+            for lane in lanes:
+                t = threading.Thread(target=lane.run, daemon=True)  # jaxlint: contained-by=Lane.run
+                t.start()
+        """, "thread-crash-containment")
+    assert out == []
+
+
+@pytest.mark.failflow
+def test_thread_containment_contained_by_weak_handler_fires():
+    out = findings("""
+        import threading
+
+        class Lane:
+            def run(self):
+                self.spin()
+
+        def launch(lanes):
+            for lane in lanes:
+                t = threading.Thread(target=lane.run, daemon=True)  # jaxlint: contained-by=Lane.run
+                t.start()
+        """, "thread-crash-containment")
+    assert len(out) == 1
+    assert "not itself contained-and-counted" in out[0].message
+
+
+@pytest.mark.failflow
+def test_span_terminal_fires_on_raise_path_orphan():
+    out = findings("""
+        class Plane:
+            def handle(self, frame):
+                tid = self.next_id()
+                TRACE.begin(tid, 0.0)
+                payload = self.decode(frame)
+                TRACE.mark_committed(tid)
+        """, "span-terminal-missing")
+    assert len(out) == 1
+    assert "orphaned span" in out[0].message
+
+
+@pytest.mark.failflow
+def test_span_terminal_clean_on_exception_edge_shed():
+    out = findings("""
+        class Plane:
+            def handle(self, frame):
+                tid = self.next_id()
+                TRACE.begin(tid, 0.0)
+                try:
+                    payload = self.decode(frame)
+                except Exception:
+                    TRACE.terminal_shed(tid)
+                    raise
+                TRACE.mark_committed(tid)
+        """, "span-terminal-missing")
+    assert out == []
+
+
+@pytest.mark.failflow
+def test_span_terminal_clean_on_escrowed_root():
+    # the trace id rides the queue entry out of the frame: custody is
+    # handed off, not orphaned
+    out = findings("""
+        class Plane:
+            def admit(self, frame):
+                tid = self.next_id()
+                TRACE.begin(tid, 0.0)
+                self.pending[tid] = frame
+        """, "span-terminal-missing")
+    assert out == []
+
+
+@pytest.mark.failflow
+def test_ledger_fires_on_unaccounted_admission():
+    out = findings("""
+        class Plane:
+            def admit(self, frame):
+                self.frames += 1
+                payload = self.decode(frame)
+                self.apply_update(payload)
+        """, "ledger-conservation")
+    assert len(out) == 1
+    assert "vanish from the ledger" in out[0].message
+
+
+@pytest.mark.failflow
+def test_ledger_clean_on_counted_dispositions():
+    out = findings("""
+        class Plane:
+            def admit(self, frame):
+                self.frames += 1
+                try:
+                    payload = self.decode(frame)
+                except Exception:
+                    self.torn += 1
+                    return
+                self.pending.append(payload)
+        """, "ledger-conservation")
+    assert out == []
+
+
+@pytest.mark.failflow
+def test_fail_cli_mode(tmp_path, capsys):
+    """`--fail` prints the exception-flow artifact; exit 1 iff a family
+    fires."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import threading
+
+        class Plane:
+            def start(self):
+                self._t = threading.Thread(target=self._serve)
+                self._t.start()
+
+            def _serve(self):
+                self.handle_one()
+        """))
+    assert lint_main(["--fail", str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "thread roles" in out and "finding(s)" in out
+
+    good = tmp_path / "good.py"
+    good.write_text(textwrap.dedent("""
+        import threading
+
+        class Plane:
+            def start(self):
+                self._t = threading.Thread(target=self._serve)
+                self._t.start()
+
+            def _serve(self):
+                try:
+                    self.handle_one()
+                except Exception:
+                    self.contained_crashes += 1
+        """))
+    assert lint_main(["--fail", str(good)]) == 0
+    out = capsys.readouterr().out
+    assert "[contained]" in out and "findings: none" in out
+
+
+# ------------------------------------------------- --json plumbing --------
+
+def _run_json(argv, capsys):
+    import json
+
+    rc = lint_main(argv)
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["schema"] == 1
+    assert isinstance(doc["findings"], list)
+    assert isinstance(doc["errors"], list)
+    return rc, doc
+
+
+def test_json_default_mode(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+
+        def f(key):
+            a = jax.random.normal(key)
+            b = jax.random.normal(key)
+            return a + b
+        """))
+    rc, doc = _run_json(["--json", str(bad)], capsys)
+    assert rc == 1 and doc["mode"] == "findings"
+    assert any(f["rule"] == "prng-key-reuse" for f in doc["findings"])
+    f = doc["findings"][0]
+    assert set(f) == {"file", "line", "col", "rule", "message", "suppressed"}
+
+
+def test_json_locks_mode(tmp_path, capsys):
+    src = tmp_path / "locks.py"
+    src.write_text("x = 1\n")
+    rc, doc = _run_json(["--locks", "--json", str(src)], capsys)
+    assert rc == 0 and doc["mode"] == "locks"
+    assert {"functions", "nodes", "edges", "cycles"} <= set(doc)
+
+
+def test_json_wire_mode(tmp_path, capsys):
+    src = tmp_path / "wire.py"
+    src.write_text("x = 1\n")
+    rc, doc = _run_json(["--wire", "--json", str(src)], capsys)
+    assert rc == 0 and doc["mode"] == "wire"
+    assert {"functions", "modules", "magics", "flags"} <= set(doc)
+
+
+@pytest.mark.failflow
+def test_json_fail_mode(tmp_path, capsys):
+    src = tmp_path / "fail.py"
+    src.write_text(textwrap.dedent("""
+        import threading
+
+        class Plane:
+            def start(self):
+                self._t = threading.Thread(target=self._serve)
+                self._t.start()
+
+            def _serve(self):
+                self.handle_one()
+        """))
+    rc, doc = _run_json(["--fail", "--json", str(src)], capsys)
+    assert rc == 1 and doc["mode"] == "fail"
+    assert {"threads", "spans", "ledger", "handlers"} <= set(doc)
+    assert doc["threads"] and doc["threads"][0]["status"] == "escapes"

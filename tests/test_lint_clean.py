@@ -47,20 +47,25 @@ def test_suppression_audit():
     in the package + bench.py: a disable must name only REGISTERED rules
     (a typo'd rule id suppresses nothing and rots silently), a
     guarded-by must name a lock the whole-program lock graph actually
-    knows (a typo'd lock name vouches for nothing), and both must carry
-    a justification comment on the flagged line's neighborhood (the
-    documented contract — see docs/architecture.md "Suppressions"). New
-    packages (e.g. fleet/) ride the same audit automatically."""
+    knows (a typo'd lock name vouches for nothing), a ``contained-by``
+    must name a handler the exception-flow graph resolved AND verified
+    contained-and-counted (status ``ok`` — a typo'd or weak handler
+    vouches for nothing), and all must carry a justification comment on
+    the flagged line's neighborhood (the documented contract — see
+    docs/architecture.md "Suppressions"). New packages (e.g. fleet/)
+    ride the same audit automatically."""
     import re
 
-    from d4pg_tpu.lint.engine import build_lock_graph
+    from d4pg_tpu.lint.engine import build_fail_graph, build_lock_graph
     from d4pg_tpu.lint.lockgraph import _DEFAULT_TIERS
     from d4pg_tpu.lint.rules import RULES
 
     directive = re.compile(r"#\s*jaxlint:\s*disable(?:-file)?=([\w,\- ]+)")
     guarded = re.compile(r"#\s*jaxlint:\s*guarded-by=([\w,\- ]+)")
+    contained = re.compile(r"#\s*jaxlint:\s*contained-by=([\w\.\-,]+)")
     graph, _errors = build_lock_graph([PACKAGE_DIR])
     known_locks = set(graph.nodes) | set(_DEFAULT_TIERS)
+    fail_graph, _errors = build_fail_graph([PACKAGE_DIR])
     audited = 0
     problems = []
     files = [os.path.join(REPO_ROOT, "bench.py")]
@@ -73,9 +78,11 @@ def test_suppression_audit():
         for i, line in enumerate(lines):
             m = directive.search(line)
             g = guarded.search(line)
+            c = contained.search(line)
             # the lint package's own docs/fixtures mention the directives
             # in strings — only audit real trailing-comment annotations
-            if (m is None and g is None) or os.sep + "lint" + os.sep in path:
+            if (m is None and g is None and c is None) \
+                    or os.sep + "lint" + os.sep in path:
                 continue
             audited += 1
             where = f"{os.path.relpath(path, REPO_ROOT)}:{i + 1}"
@@ -89,13 +96,21 @@ def test_suppression_audit():
                         problems.append(
                             f"{where}: guarded-by names unknown lock "
                             f"{lock!r} (not in the discovered lock graph)")
+            if c is not None:
+                for spec in c.group(1).split(","):
+                    if fail_graph.handlers.get(spec) != "ok":
+                        problems.append(
+                            f"{where}: contained-by names handler {spec!r} "
+                            f"with audit status "
+                            f"{fail_graph.handlers.get(spec)!r} (must "
+                            f"resolve to a contained-and-counted frame)")
             lo, hi = max(0, i - 6), min(len(lines), i + 2)
             neighborhood = "".join(lines[lo:hi])
             # justification = at least one comment line near the
             # annotation that is NOT itself a directive
             has_comment = any(
                 "#" in nl and not directive.search(nl)
-                and not guarded.search(nl)
+                and not guarded.search(nl) and not contained.search(nl)
                 for nl in lines[lo:hi]) or '"""' in neighborhood
             if not has_comment:
                 problems.append(f"{where}: annotation without an adjacent "
@@ -232,3 +247,76 @@ def test_cli_wire_mode_clean():
                   "0xD4F8", "0xD4FA", "0xD4FC", "D4RS"):
         assert magic in proc.stdout, proc.stdout
     assert "flag bits:" in proc.stdout
+
+
+@pytest.mark.lint
+@pytest.mark.failflow
+def test_fail_graph_clean_over_package():
+    """Tier-1 gate for the crash-containment surface: the whole-program
+    exception-flow graph over ``d4pg_tpu/`` must show every thread spawn
+    contained (or covered by an audited ``contained-by`` declaration),
+    every trace begin settled or escrowed, every admission counter
+    balanced, and zero findings."""
+    from d4pg_tpu.lint.engine import build_fail_graph
+    from d4pg_tpu.lint.failgraph import format_failgraph
+
+    graph, errors = build_fail_graph([PACKAGE_DIR])
+    assert not errors, errors
+    assert graph.findings == [], format_failgraph(graph)
+    assert graph.threads, "no thread spawns discovered — walker rot?"
+    for site, target, status in graph.threads:
+        assert status in ("contained", "no-raise", "contained-by"), (
+            site, target, status)
+    for site, root, status in graph.spans:
+        assert status in ("settled", "escrow"), (site, root, status)
+    for site, counter, status in graph.ledger:
+        assert status == "balanced", (site, counter, status)
+    # the fleet lane spawn's declaration is resolved and verified
+    assert graph.handlers.get("ThrottledSender.run") == "ok", graph.handlers
+    # the five wire planes' serve/accept loops are all discovered
+    discovered = " ".join(t for _s, t, _st in graph.threads)
+    for frame in ("TransitionReceiver._accept", "AggregatorServer._serve",
+                  "WeightServer._accept", "PolicyInferenceServer._batcher",
+                  "ReplayService._commit_loop"):
+        assert frame in discovered, discovered
+
+
+@pytest.mark.lint
+@pytest.mark.failflow
+def test_cli_fail_mode_clean():
+    """``python -m d4pg_tpu.lint --fail`` is the review artifact for
+    thread/obs PRs; it must exit 0 on the repo, print the thread-role
+    table, and report no findings."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "d4pg_tpu.lint", "--fail", PACKAGE_DIR],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "findings: none" in proc.stdout
+    assert "thread roles" in proc.stdout
+    assert "contained-by=ThrottledSender.run [ok]" in proc.stdout
+
+
+@pytest.mark.lint
+def test_cli_json_modes_clean():
+    """``--json`` is the machine contract for all four CLI modes: each
+    emits one schema-1 document on stdout with the mode's artifact keys,
+    and exits clean on the repo."""
+    import json
+
+    expect = {
+        (): ("findings", {"suppressed"}),
+        ("--locks",): ("locks", {"functions", "nodes", "edges", "cycles"}),
+        ("--wire",): ("wire", {"functions", "modules", "magics", "flags"}),
+        ("--fail",): ("fail", {"functions", "modules", "threads", "spans",
+                               "ledger", "handlers"}),
+    }
+    for flags, (mode, keys) in expect.items():
+        proc = subprocess.run(
+            [sys.executable, "-m", "d4pg_tpu.lint", *flags, "--json",
+             PACKAGE_DIR],
+            capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+        assert proc.returncode == 0, (flags, proc.stdout + proc.stderr)
+        doc = json.loads(proc.stdout)
+        assert doc["schema"] == 1 and doc["mode"] == mode, (flags, doc)
+        assert doc["findings"] == [] and doc["errors"] == [], (flags, doc)
+        assert keys <= set(doc), (flags, sorted(doc))

@@ -348,6 +348,25 @@ def test_trace_shed_frames_get_terminal_spans(rng):
 
 # ----------------------------------------------------- flight recorder ----
 
+@pytest.mark.failflow
+def test_contained_crash_counts_and_flight_records():
+    """The shared thread-top-frame containment helper: one counter bump
+    on ``threads.contained_crashes`` plus one flight event carrying the
+    role and the exception — the breadcrumb every wrapped plane thread
+    leaves instead of dying silently."""
+    from d4pg_tpu.obs.containment import contained_crash
+
+    ctr = REGISTRY.counter("threads.contained_crashes")
+    before = ctr.value
+    obs_flight.RECORDER.reset()
+    contained_crash("test.lane", ValueError("boom"))
+    assert ctr.value == before + 1
+    events = [e for e in obs_flight.RECORDER.events()
+              if e["kind"] == "thread_crash_contained"]
+    assert events and events[-1]["role"] == "test.lane"
+    assert events[-1]["error"] == "ValueError: boom"
+
+
 def test_flight_recorder_ring_bounded_and_dump(tmp_path):
     rec = obs_flight.FlightRecorder(maxlen=8)
     for i in range(20):

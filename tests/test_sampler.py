@@ -433,10 +433,16 @@ def test_sampler_chaos_smoke():
         run_sampler_chaos,
     )
 
+    from d4pg_tpu.obs.registry import REGISTRY
+
+    crashes0 = REGISTRY.counter("threads.contained_crashes").value
     rep = run_sampler_chaos(SamplerChaosConfig(
         sample_path="dealer", n_actors=4, duration_s=3.0,
         rows_per_sec=40.0, learner_kills=1, stale_frames=3, seed=3))
     assert rep["deadlocks"] == 0
+    # chaos is injected through narrow, expected-error paths; the broad
+    # top-frame containments must never fire during a clean run
+    assert REGISTRY.counter("threads.contained_crashes").value == crashes0
     assert rep["hierarchy_violations"] == 0
     assert rep["trace_orphans"] == 0
     assert rep["sampler"]["dealt_dead_tickets"] == 0

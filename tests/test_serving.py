@@ -347,10 +347,16 @@ def test_serving_chaos_smoke():
         run_serving_chaos,
     )
 
+    from d4pg_tpu.obs.registry import REGISTRY
+
+    crashes0 = REGISTRY.counter("threads.contained_crashes").value
     rep = run_serving_chaos(ServingChaosConfig(
         n_lanes=2, envs_per_lane=2, duration_s=1.5, server_kills=1,
         torn_prob=0.1, seed=3))
     assert rep["server_kills"] == 1
+    # chaos is injected through narrow, expected-error paths; the broad
+    # top-frame containments must never fire during a clean run
+    assert REGISTRY.counter("threads.contained_crashes").value == crashes0
     assert rep["mttr_s"] and rep["mttr_s"][0] is not None
     assert rep["torn"]["injected"] > 0
     assert rep["torn"]["accepted"] == 0

@@ -470,10 +470,16 @@ def test_weight_chaos_smoke():
     this run is the bench artifact's weights block."""
     from d4pg_tpu.fleet.weight_chaos import WeightChaosConfig, run_weight_chaos
 
+    from d4pg_tpu.obs.registry import REGISTRY
+
+    crashes0 = REGISTRY.counter("threads.contained_crashes").value
     rep = run_weight_chaos(WeightChaosConfig(
         n_pullers=8, relay_depth=2, duration_s=2.5,
         learner_kills=1, relay_kills=1, seed=3))
     assert rep["learner_kills"] == 1 and rep["final_generation"] == 1
+    # chaos is injected through narrow, expected-error paths; the broad
+    # top-frame containments must never fire during a clean run
+    assert REGISTRY.counter("threads.contained_crashes").value == crashes0
     assert rep["torn"]["accepted"] == 0
     assert rep["ledger"]["monotone"] is True
     assert rep["ledger"]["unpublished_accepted"] == 0

@@ -94,6 +94,9 @@ def test_ingest_receiver_counts_hostile_frames_and_survives():
     """Bad magic, oversize length, and a hostile-but-well-framed body
     are each a COUNTED rejection; a truncated frame (peer death) is a
     clean uncounted drop; the receiver keeps serving afterwards."""
+    from d4pg_tpu.obs.registry import REGISTRY
+
+    crashes0 = REGISTRY.counter("threads.contained_crashes").value
     with _CrashTrap() as trap:
         received = []
         recv = TransitionReceiver(lambda b, aid, c: received.append(b),
@@ -156,6 +159,10 @@ def test_ingest_receiver_counts_hostile_frames_and_survives():
         finally:
             recv.close()
     assert not trap.crashes, trap.crashes
+    # hostile frames ride the narrow protocol-error paths; the broad
+    # top-frame containment (which would hide a crash from the trap
+    # above) must not have fired either
+    assert REGISTRY.counter("threads.contained_crashes").value == crashes0
 
 
 # ------------------------------------------------- weights v1 plane ----
@@ -307,7 +314,9 @@ def test_update_server_hostile_header_drops_conn_without_thread_death():
     from d4pg_tpu.distributed.update_plane import AggregatorServer
     from d4pg_tpu.distributed.weights import WeightStore
     from d4pg_tpu.learner.aggregator import Aggregator
+    from d4pg_tpu.obs.registry import REGISTRY
 
+    crashes0 = REGISTRY.counter("threads.contained_crashes").value
     with _CrashTrap() as trap:
         agg = Aggregator(WeightStore())
         server = AggregatorServer(agg)
@@ -323,6 +332,9 @@ def test_update_server_hostile_header_drops_conn_without_thread_death():
             server.close()
             agg.close()
     assert not trap.crashes, trap.crashes
+    # same bar as the ingest fuzz: the broad containment (invisible to
+    # the excepthook trap) must not have absorbed a crash either
+    assert REGISTRY.counter("threads.contained_crashes").value == crashes0
 
 
 # --------------------------------------------------- serving plane ----
