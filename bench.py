@@ -180,10 +180,18 @@ def bench_fused(k: int = 40, capacity: int = 200_000,
     compilations after the warmup dispatch — a silent recompile would turn
     the headline number into compilation-time measurement) and
     ``TransferSentinel`` (explicit host<->device transfers; the fused
-    path's claim is that steady state makes none)."""
+    path's claim is that steady state makes none), and the
+    ``ReshardSentinel`` count of resharding collectives (all-to-all /
+    collective-permute) in the compiled HLO of the fused dispatch — the
+    dynamic twin of the ``sharding-spec-drift`` lint family, asserted
+    zero."""
     import jax
 
-    from d4pg_tpu.io.profiling import RecompileSentinel, TransferSentinel
+    from d4pg_tpu.io.profiling import (
+        RecompileSentinel,
+        ReshardSentinel,
+        TransferSentinel,
+    )
     from d4pg_tpu.learner import init_state
     from d4pg_tpu.learner.fused import make_fused_chunk
     from d4pg_tpu.replay.fused_buffer import FusedDeviceReplay
@@ -198,6 +206,11 @@ def bench_fused(k: int = 40, capacity: int = 200_000,
     state, buffer.trees, m = fn(state, buffer.trees, buffer.storage,
                                 buffer.size)  # warmup/compile
     jax.block_until_ready(m["critic_loss"])
+    # lower() never executes (so donated buffers survive): scan the HLO
+    # the warm cache will replay for resharding copies before timing it
+    reshards = ReshardSentinel()
+    reshards.inspect(fn, state, buffer.trees, buffer.storage, buffer.size)
+    reshards.assert_clean("bench_fused compiled dispatch")
     n_dispatch = max(1, steps // k)
     rates = []
     with RecompileSentinel() as recompiles, TransferSentinel() as transfers:
@@ -209,7 +222,8 @@ def bench_fused(k: int = 40, capacity: int = 200_000,
             jax.block_until_ready(m["critic_loss"])
             rates.append(n_dispatch * k / (time.perf_counter() - t0))
     recompiles.assert_clean("bench_fused steady-state loop")
-    return rates, recompiles.compilations, transfers.total
+    return (rates, recompiles.compilations, transfers.total,
+            reshards.steady_state_reshards)
 
 
 def bench_ingest(capacity: int = 200_000, block_rows: int = 4096,
@@ -875,7 +889,8 @@ def main():
         "auto", batch_size=BATCH, v_min=0.0, v_max=800.0, n_atoms=N_ATOMS)
     device_only_rates = bench_tpu()
     device_only = float(np.median(device_only_rates))
-    fused_rates, fused_recompiles, fused_transfers = bench_fused()
+    (fused_rates, fused_recompiles, fused_transfers,
+     fused_reshards) = bench_fused()
     fused = float(np.median(fused_rates))
     host_pipeline = bench_end_to_end()
     ingest = bench_ingest()
@@ -915,6 +930,10 @@ def main():
         # above timed the compiler/PCIe, not the learner
         "steady_state_recompiles": fused_recompiles,
         "steady_state_explicit_transfers": fused_transfers,
+        # resharding collectives (all-to-all/collective-permute) in the
+        # compiled HLO of the fused dispatch — ReshardSentinel, the
+        # dynamic twin of the sharding-spec-drift lint family; asserted 0
+        "steady_state_reshards": fused_reshards,
         "host_pipeline_e2e": round(host_pipeline, 2),
         # ingest plane (rows/sec): block drain solo + overlapped with the
         # fused chunk, vs the old per-row drain; h2d_per_chunk must be

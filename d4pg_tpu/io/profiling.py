@@ -6,12 +6,15 @@ traces on demand (viewable in TensorBoard/Perfetto) and an EWMA'd
 grad-steps/sec meter — the north-star metric (BASELINE.md) — cheap enough
 to leave on.
 
-The two sentinels are the runtime complement of the static ``jaxlint``
+The sentinels are the runtime complement of the static ``jaxlint``
 pass (``d4pg_tpu/lint``): the linter catches hazards it can see in the
 AST; the sentinels catch what it can't — a hot loop that recompiles in
 steady state (``RecompileSentinel``, wired into ``bench.py`` and the
-learner tests) or round-trips data between host and device per step
-(``TransferSentinel``).
+learner tests), round-trips data between host and device per step
+(``TransferSentinel``), or compiles to a program that silently reshards
+a tree between layouts (``ReshardSentinel``, the dynamic twin of the
+``sharding-spec-drift`` lint family the way RecompileSentinel twins
+``recompile-hazard``).
 """
 
 from __future__ import annotations
@@ -124,6 +127,78 @@ class RecompileSentinel:
                 f"{what} triggered {self.compilations} XLA compilation(s) "
                 "after warmup — a static-shape or weak-type mismatch is "
                 "defeating the jit cache")
+
+
+class ReshardError(AssertionError):
+    """A path that must keep one layout compiled to resharding copies."""
+
+
+class ReshardSentinel:
+    """Counts resharding collectives in a jitted callable's compiled HLO.
+
+    The static ``sharding-spec-drift`` family flags trees that the SOURCE
+    places under two different partition factories; this sentinel is its
+    dynamic twin — it reads what XLA actually compiled.  A clean fused
+    learner path contains gradient ``all-reduce``s (expected: that IS
+    data parallelism) but no ``all-to-all`` or ``collective-permute``:
+    those only appear when GSPMD had to move a tree between layouts
+    mid-program, i.e. an implicit reshard paying a full device-to-device
+    copy every step.
+
+        sentinel = ReshardSentinel()
+        sentinel.inspect(fn, *warmup_args)   # fn.lower(...).compile()
+        sentinel.assert_clean("fused learner path")
+        assert sentinel.steady_state_reshards == 0
+    """
+
+    # Ops that MOVE data between layouts.  all-reduce/all-gather are
+    # deliberately absent: gradient reduction and merge broadcasts are
+    # the collectives the program is SUPPOSED to contain.
+    _RESHARD_OPS = ("all-to-all", "collective-permute")
+
+    def __init__(self):
+        self.reshards = 0
+        self.ops: dict[str, int] = {}
+
+    @property
+    def steady_state_reshards(self) -> int:
+        return self.reshards
+
+    def inspect(self, fn, *args, **kwargs) -> int:
+        """Lower+compile ``fn`` for ``args`` and scan the HLO text.
+        ``lower`` never executes (and never consumes donated buffers), so
+        this is safe to run against live training state."""
+        lowered = fn.lower(*args, **kwargs)
+        try:
+            text = lowered.compile().as_text()
+        except Exception:  # backends without compiled-text introspection
+            text = lowered.as_text()
+        return self.inspect_text(text)
+
+    def inspect_text(self, hlo_text: str) -> int:
+        found = 0
+        for op in self._RESHARD_OPS:
+            n = hlo_text.count(op)
+            if n:
+                self.ops[op] = self.ops.get(op, 0) + n
+                found += n
+        self.reshards += found
+        # same unified ledger as the other sentinels: bench artifacts and
+        # the fleet report read one counter instead of private copies
+        from d4pg_tpu.obs.registry import REGISTRY
+
+        REGISTRY.counter("profiling.reshards").inc(found)
+        return found
+
+    def assert_clean(self, what: str = "steady-state path") -> None:
+        if self.reshards:
+            detail = ", ".join(f"{op} x{n}"
+                               for op, n in sorted(self.ops.items()))
+            raise ReshardError(
+                f"{what} compiled to {self.reshards} resharding "
+                f"collective(s) ({detail}) — a tree is produced under one "
+                f"sharding spec and consumed under another; route both "
+                f"through the same parallel/partition.py factory")
 
 
 class TransferSentinel:

@@ -15,9 +15,18 @@ for protocol-touching PRs; exit 1 iff any wire family fires.
 the exception-flow pass (families 16-18) — the review artifact for
 thread- or obs-touching PRs; exit 1 iff any fail family fires.
 
-``--json`` switches any of the four modes to a machine-readable document
-on stdout: ``{"schema": 1, "mode": ..., "findings": [...], ...}`` — the
-contract tests/test_lint_clean.py gates so CI tooling never scrapes the
+``--mesh`` prints the sharding/collective graph (shard_map sites with
+bound axes, collective uses with binding witnesses, the sharding
+dataflow table, donation sites) from the mesh pass (families 19-21) —
+the review artifact for sharding-touching PRs; exit 1 iff any mesh
+family fires.
+
+``--all`` runs the syntactic families AND all five graph modes and
+emits ONE merged document — the single entrypoint CI gates on.
+
+``--json`` switches any mode to a machine-readable document on stdout:
+``{"schema": 1, "mode": ..., "findings": [...], ...}`` — the contract
+tests/test_lint_clean.py gates so CI tooling never scrapes the
 human-oriented text.
 """
 
@@ -31,6 +40,7 @@ import sys
 from d4pg_tpu.lint.engine import (
     build_fail_graph,
     build_lock_graph,
+    build_mesh_graph,
     build_wire_graph,
     lint_paths,
 )
@@ -55,6 +65,62 @@ def _doc(mode: str, findings, errors, **extra) -> dict:
            "errors": list(errors)}
     doc.update(extra)
     return doc
+
+
+# Per-mode artifact keys, shared by the single-mode ``--json`` documents
+# and the merged ``--all`` document (one encoder per artifact — the two
+# paths cannot drift).
+
+def _locks_extra(graph) -> dict:
+    return {
+        "functions": graph.functions,
+        "nodes": {n: t for n, t in sorted(graph.nodes.items())},
+        "edges": [{"held": a, "acquired": b, "witnesses": w}
+                  for (a, b), w in sorted(graph.edges.items())],
+        "cycles": graph.cycles,
+    }
+
+
+def _wire_extra(graph) -> dict:
+    return {
+        "functions": graph.functions, "modules": graph.modules,
+        "magics": {_magic_key(m): info
+                   for m, info in sorted(graph.magics.items(),
+                                         key=lambda kv: _magic_key(kv[0]))},
+        "flags": {plane: {str(bit): meaning
+                          for bit, meaning in sorted(bits.items())}
+                  for plane, bits in sorted(graph.flags.items())},
+    }
+
+
+def _fail_extra(graph) -> dict:
+    return {
+        "functions": graph.functions, "modules": graph.modules,
+        "threads": [{"site": s, "target": t, "status": st}
+                    for s, t, st in sorted(graph.threads)],
+        "spans": [{"site": s, "root": r, "status": st}
+                  for s, r, st in sorted(graph.spans)],
+        "ledger": [{"site": s, "counter": c, "status": st}
+                   for s, c, st in sorted(graph.ledger)],
+        "handlers": dict(sorted(graph.handlers.items())),
+    }
+
+
+def _mesh_extra(graph) -> dict:
+    return {
+        "functions": graph.functions, "modules": graph.modules,
+        "axes": dict(graph.axes),
+        "shard_maps": [{"site": s, "body": b, "axes": a}
+                       for s, b, a in sorted(graph.shard_maps)],
+        "collectives": [{"site": s, "op": op, "axis": ax, "witness": w,
+                         "status": st}
+                        for s, op, ax, w, st in sorted(graph.collectives)],
+        "shardings": [{"site": s, "kind": k, "resolution": r, "status": st}
+                      for s, k, r, st in sorted(graph.shardings)],
+        "donations": [{"site": s, "callee": c, "donated": d, "status": st}
+                      for s, c, d, st in sorted(graph.donations)],
+        "handlers": dict(sorted(graph.handlers.items())),
+    }
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -84,6 +150,16 @@ def main(argv: list[str] | None = None) -> int:
                              "span-lifecycle graph (families 16-18) "
                              "instead of findings; exit 1 iff any fail "
                              "family fires")
+    parser.add_argument("--mesh", action="store_true",
+                        help="print the sharding/collective graph "
+                             "(shard_map sites, collective bindings, "
+                             "sharding dataflow, donation sites; "
+                             "families 19-21) instead of findings; exit "
+                             "1 iff any mesh family fires")
+    parser.add_argument("--all", action="store_true", dest="all_modes",
+                        help="run the syntactic families AND all five "
+                             "graph modes; emit ONE merged document "
+                             "(--json) or every artifact in sequence")
     parser.add_argument("--json", action="store_true",
                         help="emit a machine-readable document instead of "
                              "the human-oriented text (all modes)")
@@ -104,11 +180,7 @@ def main(argv: list[str] | None = None) -> int:
         if args.json:
             print(json.dumps(_doc(
                 "locks", graph.findings, errors,
-                functions=graph.functions,
-                nodes={n: t for n, t in sorted(graph.nodes.items())},
-                edges=[{"held": a, "acquired": b, "witnesses": w}
-                       for (a, b), w in sorted(graph.edges.items())],
-                cycles=graph.cycles), indent=2))
+                **_locks_extra(graph)), indent=2))
         else:
             print(format_graph(graph))
             for e in errors:
@@ -122,15 +194,7 @@ def main(argv: list[str] | None = None) -> int:
         if args.json:
             print(json.dumps(_doc(
                 "wire", graph.findings, errors,
-                functions=graph.functions, modules=graph.modules,
-                magics={_magic_key(m): info
-                        for m, info in sorted(graph.magics.items(),
-                                              key=lambda kv:
-                                              _magic_key(kv[0]))},
-                flags={plane: {str(bit): meaning
-                               for bit, meaning in sorted(bits.items())}
-                       for plane, bits in sorted(graph.flags.items())}),
-                indent=2))
+                **_wire_extra(graph)), indent=2))
         else:
             print(format_registry(graph))
             for e in errors:
@@ -144,19 +208,69 @@ def main(argv: list[str] | None = None) -> int:
         if args.json:
             print(json.dumps(_doc(
                 "fail", graph.findings, errors,
-                functions=graph.functions, modules=graph.modules,
-                threads=[{"site": s, "target": t, "status": st}
-                         for s, t, st in sorted(graph.threads)],
-                spans=[{"site": s, "root": r, "status": st}
-                       for s, r, st in sorted(graph.spans)],
-                ledger=[{"site": s, "counter": c, "status": st}
-                        for s, c, st in sorted(graph.ledger)],
-                handlers=dict(sorted(graph.handlers.items()))), indent=2))
+                **_fail_extra(graph)), indent=2))
         else:
             print(format_failgraph(graph))
             for e in errors:
                 print(e, file=sys.stderr)
         return 1 if graph.findings else 0
+
+    if args.mesh:
+        from d4pg_tpu.lint.meshgraph import format_meshgraph
+
+        graph, errors = build_mesh_graph(paths)
+        if args.json:
+            print(json.dumps(_doc(
+                "mesh", graph.findings, errors,
+                **_mesh_extra(graph)), indent=2))
+        else:
+            print(format_meshgraph(graph))
+            for e in errors:
+                print(e, file=sys.stderr)
+        return 1 if graph.findings else 0
+
+    if args.all_modes:
+        from d4pg_tpu.lint.failgraph import format_failgraph
+        from d4pg_tpu.lint.lockgraph import format_graph
+        from d4pg_tpu.lint.meshgraph import format_meshgraph
+        from d4pg_tpu.lint.wiregraph import format_registry
+
+        result = lint_paths(paths)
+        locks, lock_errs = build_lock_graph(paths)
+        wire, wire_errs = build_wire_graph(paths)
+        fail, fail_errs = build_fail_graph(paths)
+        mesh, mesh_errs = build_mesh_graph(paths)
+        # lint_paths already runs every program family, so its findings
+        # list IS the merged findings list; the per-mode sections carry
+        # the review artifacts (and re-state each mode's own findings)
+        dirty = (not result.clean) or bool(locks.cycles)
+        if args.json:
+            print(json.dumps(_doc(
+                "all", result.findings, result.errors,
+                suppressed=len(result.suppressed),
+                locks={"findings": [_finding_doc(f)
+                                    for f in locks.findings],
+                       "errors": lock_errs, **_locks_extra(locks)},
+                wire={"findings": [_finding_doc(f) for f in wire.findings],
+                      "errors": wire_errs, **_wire_extra(wire)},
+                fail={"findings": [_finding_doc(f) for f in fail.findings],
+                      "errors": fail_errs, **_fail_extra(fail)},
+                mesh={"findings": [_finding_doc(f) for f in mesh.findings],
+                      "errors": mesh_errs, **_mesh_extra(mesh)}),
+                indent=2))
+            return 1 if dirty else 0
+        for block in (format_graph(locks), format_registry(wire),
+                      format_failgraph(fail), format_meshgraph(mesh)):
+            print(block)
+            print()
+        for f in result.findings:
+            print(f.format())
+        for e in (result.errors + lock_errs + wire_errs + fail_errs
+                  + mesh_errs):
+            print(e, file=sys.stderr)
+        n, s = len(result.findings), len(result.suppressed)
+        print(f"jaxlint: {n} finding(s), {s} suppressed", file=sys.stderr)
+        return 1 if dirty else 0
 
     rules = None
     if args.rules:

@@ -70,12 +70,15 @@ def _run_program_rules(ctxs: list[ModuleContext], program_ids: list[str],
     if not program_ids or not ctxs:
         return
     from d4pg_tpu.lint.failgraph import FAIL_RULES
+    from d4pg_tpu.lint.meshgraph import MESH_RULES
     from d4pg_tpu.lint.wiregraph import WIRE_RULES
 
     lock_ids = [r for r in program_ids
-                if r not in WIRE_RULES and r not in FAIL_RULES]
+                if r not in WIRE_RULES and r not in FAIL_RULES
+                and r not in MESH_RULES]
     wire_ids = [r for r in program_ids if r in WIRE_RULES]
     fail_ids = [r for r in program_ids if r in FAIL_RULES]
+    mesh_ids = [r for r in program_ids if r in MESH_RULES]
     per_file: dict[str, list[Finding]] = {}
     if lock_ids:
         from d4pg_tpu.lint import lockgraph
@@ -91,6 +94,11 @@ def _run_program_rules(ctxs: list[ModuleContext], program_ids: list[str],
         from d4pg_tpu.lint import failgraph
 
         for f in failgraph.analyze(ctxs, rules=fail_ids).findings:
+            per_file.setdefault(f.file, []).append(f)
+    if mesh_ids:
+        from d4pg_tpu.lint import meshgraph
+
+        for f in meshgraph.analyze(ctxs, rules=mesh_ids).findings:
             per_file.setdefault(f.file, []).append(f)
     for path, found in sorted(per_file.items()):
         _sift(found, sups.get(path, Suppressions()), result)
@@ -197,4 +205,24 @@ def build_fail_graph(paths: list[str]):
         except (OSError, SyntaxError) as e:
             errors.append(f"{path}: {e}")
     graph = failgraph.analyze(ctxs)
+    return graph, errors
+
+
+def build_mesh_graph(paths: list[str]):
+    """The ``--mesh`` review artifact: shard_map sites with bound axes,
+    collective uses with binding witnesses, the sharding dataflow table,
+    and donation call sites over ``paths`` (plus findings from families
+    19-21)."""
+    from d4pg_tpu.lint import meshgraph
+
+    ctxs: list[ModuleContext] = []
+    errors: list[str] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            ctxs.append(build_context(path, source))
+        except (OSError, SyntaxError) as e:
+            errors.append(f"{path}: {e}")
+    graph = meshgraph.analyze(ctxs)
     return graph, errors

@@ -1,6 +1,6 @@
 """The jaxlint rule catalog.
 
-Eighteen rule families, each targeting a hazard that silently costs
+Twenty-one rule families, each targeting a hazard that silently costs
 throughput or correctness on this stack (see docs/architecture.md "Static
 analysis & perf sentinels" for the rationale and suppression policy):
 
@@ -27,12 +27,21 @@ analysis & perf sentinels" for the rationale and suppression policy):
   exit that never reaches a commit/shed terminal
 - ``ledger-conservation``  — admission-counter bump whose path to exit
   records no disposition and no hand-off
+- ``collective-axis-unbound`` — psum/pmean/axis_index axis_name with no
+  reachable shard_map binding, or an axis hand-spelled/undeclared;
+  ``# jaxlint: axis-bound-by=<caller>`` declares an audited binder
+- ``sharding-spec-drift``  — in/out_shardings/device_put spec reaching a
+  raw sharding constructor through dataflow, or a tree re-placed under a
+  different partition factory (implicit reshard)
+- ``donation-alias``       — donate_argnums call whose donated argument
+  aliases another argument or a live captured reference
 
-The last nine are PROGRAM-scope families implemented in
-``lint/lockgraph.py`` (locks), ``lint/wiregraph.py`` (wire protocol) and
-``lint/failgraph.py`` (exception flow / ledger): they analyze every
-module of a lint run together (cross-module call graph), where
-everything above is per-module.
+The last twelve are PROGRAM-scope families implemented in
+``lint/lockgraph.py`` (locks), ``lint/wiregraph.py`` (wire protocol),
+``lint/failgraph.py`` (exception flow / ledger) and ``lint/meshgraph.py``
+(sharding & collectives): they analyze every module of a lint run
+together (cross-module call graph), where everything above is
+per-module.
 
 Every rule is a function ``(ModuleContext) -> list[Finding]`` registered in
 ``RULES``. Rules are deliberately conservative: a finding should be either
@@ -890,6 +899,17 @@ def _fail_rule(rule_id: str):
     return check
 
 
+def _mesh_rule(rule_id: str):
+    """Same single-module fallback for the sharding/collective families
+    (``lint/meshgraph.py``)."""
+    def check(ctx: ModuleContext) -> list[Finding]:
+        from d4pg_tpu.lint import meshgraph
+
+        return meshgraph.analyze([ctx], rules=[rule_id]).findings
+
+    return check
+
+
 RULES: dict[str, Rule] = {r.id: r for r in [
     Rule("prng-key-reuse",
          "same PRNG key consumed by two jax.random samplers without an "
@@ -968,4 +988,21 @@ RULES: dict[str, Rule] = {r.id: r for r in [
          "frame-admission counter bump with a path to exit that records "
          "neither a disposition counter nor a terminal hand-off",
          _fail_rule("ledger-conservation"), scope="program"),
+    Rule("collective-axis-unbound",
+         "psum/pmean/all_gather/axis_index axis_name with no reachable "
+         "shard_map binding, or an axis hand-spelled/undeclared — "
+         "declare `# jaxlint: axis-bound-by=<caller>` for helpers bound "
+         "by their callers",
+         _mesh_rule("collective-axis-unbound"), scope="program"),
+    Rule("sharding-spec-drift",
+         "in_shardings/out_shardings/device_put spec that resolves "
+         "through dataflow to a raw sharding constructor outside "
+         "parallel/partition.py, or a tree re-placed under a different "
+         "partition factory (implicit reshard)",
+         _mesh_rule("sharding-spec-drift"), scope="program"),
+    Rule("donation-alias",
+         "donate_argnums call site whose donated argument aliases "
+         "another argument or a live captured reference the call never "
+         "rebinds — the replica deep-copy defect, statically",
+         _mesh_rule("donation-alias"), scope="program"),
 ]}

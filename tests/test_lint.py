@@ -306,7 +306,8 @@ def test_rule_catalog_covers_all_families():
         "lock-cycle", "unguarded-shared-write", "wire-magic-registry",
         "codec-asymmetry", "unchecked-frame", "flag-bit-collision",
         "thread-crash-containment", "span-terminal-missing",
-        "ledger-conservation",
+        "ledger-conservation", "collective-axis-unbound",
+        "sharding-spec-drift", "donation-alias",
     }
     assert RULES["sharding-rule-bypass"].scope == "module"
     # the lock-graph and wire-graph families analyze whole programs,
@@ -316,7 +317,8 @@ def test_rule_catalog_covers_all_families():
     for rule in ("wire-magic-registry", "codec-asymmetry",
                  "unchecked-frame", "flag-bit-collision",
                  "thread-crash-containment", "span-terminal-missing",
-                 "ledger-conservation"):
+                 "ledger-conservation", "collective-axis-unbound",
+                 "sharding-spec-drift", "donation-alias"):
         assert RULES[rule].scope == "program"
     assert RULES["lock-order"].scope == "module"
 

@@ -50,22 +50,28 @@ def test_suppression_audit():
     knows (a typo'd lock name vouches for nothing), a ``contained-by``
     must name a handler the exception-flow graph resolved AND verified
     contained-and-counted (status ``ok`` — a typo'd or weak handler
-    vouches for nothing), and all must carry a justification comment on
-    the flagged line's neighborhood (the documented contract — see
-    docs/architecture.md "Suppressions"). New packages (e.g. fleet/)
-    ride the same audit automatically."""
+    vouches for nothing), an ``axis-bound-by`` must name a binder the
+    sharding graph resolved AND verified bound under a shard_map axis
+    (status ``ok`` — same bar), and all must carry a justification
+    comment on the flagged line's neighborhood (the documented contract
+    — see docs/architecture.md "Suppressions"). New packages (e.g.
+    fleet/) ride the same audit automatically."""
     import re
 
-    from d4pg_tpu.lint.engine import build_fail_graph, build_lock_graph
+    from d4pg_tpu.lint.engine import (
+        build_fail_graph, build_lock_graph, build_mesh_graph,
+    )
     from d4pg_tpu.lint.lockgraph import _DEFAULT_TIERS
     from d4pg_tpu.lint.rules import RULES
 
     directive = re.compile(r"#\s*jaxlint:\s*disable(?:-file)?=([\w,\- ]+)")
     guarded = re.compile(r"#\s*jaxlint:\s*guarded-by=([\w,\- ]+)")
     contained = re.compile(r"#\s*jaxlint:\s*contained-by=([\w\.\-,]+)")
+    bound = re.compile(r"#\s*jaxlint:\s*axis-bound-by=([\w\.\-,]+)")
     graph, _errors = build_lock_graph([PACKAGE_DIR])
     known_locks = set(graph.nodes) | set(_DEFAULT_TIERS)
     fail_graph, _errors = build_fail_graph([PACKAGE_DIR])
+    mesh_graph, _errors = build_mesh_graph([PACKAGE_DIR])
     audited = 0
     problems = []
     files = [os.path.join(REPO_ROOT, "bench.py")]
@@ -79,9 +85,10 @@ def test_suppression_audit():
             m = directive.search(line)
             g = guarded.search(line)
             c = contained.search(line)
+            b = bound.search(line)
             # the lint package's own docs/fixtures mention the directives
             # in strings — only audit real trailing-comment annotations
-            if (m is None and g is None and c is None) \
+            if (m is None and g is None and c is None and b is None) \
                     or os.sep + "lint" + os.sep in path:
                 continue
             audited += 1
@@ -104,6 +111,14 @@ def test_suppression_audit():
                             f"with audit status "
                             f"{fail_graph.handlers.get(spec)!r} (must "
                             f"resolve to a contained-and-counted frame)")
+            if b is not None:
+                for spec in b.group(1).split(","):
+                    if mesh_graph.handlers.get(spec) != "ok":
+                        problems.append(
+                            f"{where}: axis-bound-by names binder {spec!r} "
+                            f"with audit status "
+                            f"{mesh_graph.handlers.get(spec)!r} (must "
+                            f"resolve to a shard_map-bound frame)")
             lo, hi = max(0, i - 6), min(len(lines), i + 2)
             neighborhood = "".join(lines[lo:hi])
             # justification = at least one comment line near the
@@ -111,6 +126,7 @@ def test_suppression_audit():
             has_comment = any(
                 "#" in nl and not directive.search(nl)
                 and not guarded.search(nl) and not contained.search(nl)
+                and not bound.search(nl)
                 for nl in lines[lo:hi]) or '"""' in neighborhood
             if not has_comment:
                 problems.append(f"{where}: annotation without an adjacent "
@@ -298,25 +314,32 @@ def test_cli_fail_mode_clean():
 
 @pytest.mark.lint
 def test_cli_json_modes_clean():
-    """``--json`` is the machine contract for all four CLI modes: each
-    emits one schema-1 document on stdout with the mode's artifact keys,
-    and exits clean on the repo."""
+    """``python -m d4pg_tpu.lint --all --json`` is the single CI
+    entrypoint: ONE schema-1 document carrying the syntactic findings
+    AND every graph mode's artifact section (the per-mode ``--json``
+    documents are encoded by the same helpers, so gating the merged doc
+    gates them all). Must exit clean on the repo."""
     import json
 
-    expect = {
-        (): ("findings", {"suppressed"}),
-        ("--locks",): ("locks", {"functions", "nodes", "edges", "cycles"}),
-        ("--wire",): ("wire", {"functions", "modules", "magics", "flags"}),
-        ("--fail",): ("fail", {"functions", "modules", "threads", "spans",
-                               "ledger", "handlers"}),
+    proc = subprocess.run(
+        [sys.executable, "-m", "d4pg_tpu.lint", "--all", "--json",
+         PACKAGE_DIR],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["schema"] == 1 and doc["mode"] == "all", doc
+    assert doc["findings"] == [] and doc["errors"] == [], doc
+    assert "suppressed" in doc
+    sections = {
+        "locks": {"functions", "nodes", "edges", "cycles"},
+        "wire": {"functions", "modules", "magics", "flags"},
+        "fail": {"functions", "modules", "threads", "spans", "ledger",
+                 "handlers"},
+        "mesh": {"functions", "modules", "axes", "shard_maps",
+                 "collectives", "shardings", "donations", "handlers"},
     }
-    for flags, (mode, keys) in expect.items():
-        proc = subprocess.run(
-            [sys.executable, "-m", "d4pg_tpu.lint", *flags, "--json",
-             PACKAGE_DIR],
-            capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
-        assert proc.returncode == 0, (flags, proc.stdout + proc.stderr)
-        doc = json.loads(proc.stdout)
-        assert doc["schema"] == 1 and doc["mode"] == mode, (flags, doc)
-        assert doc["findings"] == [] and doc["errors"] == [], (flags, doc)
-        assert keys <= set(doc), (flags, sorted(doc))
+    for section, keys in sections.items():
+        sub = doc[section]
+        assert sub["findings"] == [] and sub["errors"] == [], (section, sub)
+        assert keys <= set(sub), (section, sorted(sub))
+    assert doc["locks"]["cycles"] == []
