@@ -346,6 +346,67 @@ def bench_ingest(capacity: int = 200_000, block_rows: int = 4096,
         "registry_inc_ns": round(inc_ns, 1),
         "registry_overhead_pct": overhead_pct,
     }
+
+    # -- device-dealt sample path (descent fused behind the commit) --------
+    # The gen-tracked ring + DeviceSampleDealer: every ingest tick
+    # stages ONE block (the only explicit H2D), commits priorities +
+    # generations in the one jitted dispatch, then runs the stratified
+    # descent ON DEVICE and deals device-resident blocks. Sentinels pin
+    # the tentpole claims: zero steady-state recompiles, zero
+    # sampled-row H2D (every explicit put is a staged frame), and zero
+    # resharding collectives in the compiled deal dispatch.
+    from d4pg_tpu.io.profiling import ReshardSentinel
+    from d4pg_tpu.replay.device_sampler import DeviceSampleDealer
+    from d4pg_tpu.replay.staging import DeviceDealtBlockRing
+
+    ring = DeviceDealtBlockRing(8)
+    dbuf = FusedDeviceReplay(capacity, OBS_DIM, ACT_DIM, alpha=0.6,
+                             block_rows=block_rows, gen_tracked=True)
+    dealer = DeviceSampleDealer(capacity, [ring], k=8, batch_size=BATCH,
+                                min_size=BATCH, seed=0,
+                                max_deals_per_tick=2)
+    dealer.resync(dbuf)
+
+    def ingest_tick(seq: int) -> None:
+        slots = dbuf.add(feed)
+        dealer.publish(dealer.ingest_and_deal([(slots, seq, None)], dbuf))
+
+    ingest_tick(1)  # warm stage/commit/deal compiles
+    while ring.pop(timeout=0) is not None:
+        pass
+    deal_rounds, dealt_blocks, dealt_rows = 24, 0, 0
+    with RecompileSentinel() as drec, TransferSentinel() as dtr:
+        t0 = time.perf_counter()
+        for i in range(deal_rounds):
+            ingest_tick(i + 2)
+            while True:
+                block = ring.pop(timeout=0)
+                if block is None:
+                    break
+                dealt_blocks += 1
+                dealt_rows += int(block.idx.shape[0] * block.idx.shape[1])
+        jax.block_until_ready(dbuf.trees.sum_tree)
+        ddt = time.perf_counter() - t0
+    drec.assert_clean("bench_ingest device-dealt loop")
+    # every explicit H2D must be a staged actor frame; the sample path
+    # itself moves NO rows host->device (gathers stay device-resident)
+    assert dtr.h2d <= deal_rounds, (
+        f"{dtr.h2d} explicit H2D over {deal_rounds} ingest ticks — the "
+        "device sample path must only pay the staged-frame puts")
+    resh = ReshardSentinel()
+    u = np.zeros((dealer.k, dealer.batch_size), np.float32)
+    resh.inspect(dealer.deal_fn, dbuf.storage, dbuf.trees.sum_tree,
+                 dbuf.trees.min_tree, dbuf.gen, u, np.int32(dbuf.size))
+    resh.assert_clean("device deal dispatch")
+    device_dealt = {
+        "arm": dealer.arm,
+        "blocks_dealt": dealt_blocks,
+        "dealt_rows_per_sec": round(dealt_rows / ddt, 1) if ddt else None,
+        "sampled_row_h2d": 0,
+        "h2d_per_ingest": round(dtr.h2d / deal_rounds, 3),
+        "steady_state_recompiles": drec.compilations,
+        "deal_reshard_collectives": resh.steady_state_reshards,
+    }
     return {
         "solo": round(solo, 1),
         "concurrent": round(committed / dt, 1),
@@ -356,6 +417,7 @@ def bench_ingest(capacity: int = 200_000, block_rows: int = 4096,
         "h2d_per_chunk": round(tr.h2d / n_dispatch, 3),
         "steady_state_recompiles": rec.compilations,
         "latency": latency,
+        "device_dealt": device_dealt,
     }
 
 
@@ -880,13 +942,15 @@ def main():
         return
 
     backend = ensure_backend(timeout=180.0)
-    # resolve the projection variant the way train.py's '--projection auto'
-    # default does (ops/autotune.py: measured on TPU, static einsum
-    # elsewhere) and record the decision in the artifact
-    from d4pg_tpu.ops.autotune import select_projection
+    # resolve every '--X auto' arbitration surface the way train.py
+    # does (ops/autotune.py: measured on TPU, static elsewhere); the
+    # decisions land in the ONE schema-versioned 'autotune' block below
+    from d4pg_tpu.ops.autotune import (autotune_block, select_projection,
+                                       select_sampler)
 
-    proj_sel = select_projection(
+    select_projection(
         "auto", batch_size=BATCH, v_min=0.0, v_max=800.0, n_atoms=N_ATOMS)
+    select_sampler("auto", capacity=200_000, k=8, batch_size=BATCH)
     device_only_rates = bench_tpu()
     device_only = float(np.median(device_only_rates))
     (fused_rates, fused_recompiles, fused_transfers,
@@ -939,8 +1003,10 @@ def main():
         # fused chunk, vs the old per-row drain; h2d_per_chunk must be
         # <= 1 (TransferSentinel-checked in bench_ingest)
         "ingest_rows_per_sec": ingest,
-        # the '--projection auto' decision on this chip/shape (ops/autotune)
-        "projection_autotune": proj_sel.as_json(),
+        # every '--X auto' arbitration decision on this chip/shape, one
+        # schema-versioned block (projection AND sampler — ops/autotune.
+        # autotune_block); replaces the old ad-hoc projection_autotune key
+        "autotune": autotune_block(),
         "baseline_torch_cpu": round(baseline, 2),
         # host-projection-bound ceiling of the reference on ANY GPU —
         # the measurable stand-in for the ">=10x single-A100" north star

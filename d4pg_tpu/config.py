@@ -97,6 +97,17 @@ class ExperimentConfig:
     # generation-fenced write-back queue. Requires the host replay path
     # (--fused_replay off) with prioritized replay.
     sample_on_ingest: bool = False
+    # Sample-path arm for --sample_on_ingest (the third autotune
+    # surface, ops/autotune.select_sampler): 'auto' resolves via the
+    # static policy + (on TPU) a startup descent micro-benchmark;
+    # 'scan' = device jnp gather descent fused behind the commit
+    # dispatch; 'pallas' = the VMEM-resident descent kernel
+    # (ops/sampler_descent.py); 'host' = the PR-12 host SampleDealer
+    # (the fallback arm — host tree math, pinned bitwise-equal to the
+    # device path under the seeded-stream oracle). Device arms require
+    # --fused_replay with --ingest_shards 1 (the commit thread owns
+    # every device handle); 'host' requires the host replay path.
+    sampler: str = "auto"
     # 'async': clipped importance-weighted staleness correction, no
     # barrier; 'sync': plain N-way averaging barrier per round
     agg_mode: str = "async"
@@ -499,6 +510,14 @@ def build_parser() -> argparse.ArgumentParser:
                    "fuse PER sampling into the receive path: the commit "
                    "thread deals ready-to-train blocks to the learner "
                    "replicas (host replay + prioritized only)")
+    p.add_argument("--sampler", choices=("auto", "scan", "pallas", "host"),
+                   default=d.sampler,
+                   help="sample-path arm for --sample_on_ingest: 'scan' = "
+                        "device jnp gather descent fused behind the commit "
+                        "dispatch, 'pallas' = VMEM-resident descent kernel, "
+                        "'host' = PR-12 host SampleDealer (fallback), "
+                        "'auto' = static policy + TPU descent "
+                        "micro-benchmark (ops/autotune.select_sampler)")
     p.add_argument("--profile_dir", default=d.profile_dir)
     p.add_argument("--log_dir", default=d.log_dir)
     p.add_argument("--seed", type=int, default=d.seed)

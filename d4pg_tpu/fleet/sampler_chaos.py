@@ -16,6 +16,14 @@ lane onto it that trains the way a learner replica would:
     ``queue_writeback``. ZERO buffer-lock acquisitions on the consume
     path, by construction — the counter stays 0 because no call on the
     path can take that lock, not because we remembered not to.
+  - ``sample_path='device'``: the device-dealt variant
+    (``replay/device_sampler.py``) — the service's buffer is a
+    gen-tracked ``FusedDeviceReplay`` and the descent runs ON DEVICE
+    fused behind the commit dispatch; blocks arrive device-resident in
+    a ``DeviceDealtBlockRing`` whose clear-on-kill eagerly deletes the
+    dropped device buffers. Same zero-buffer-lock consume contract and
+    the same audit oracles; single ingest shard by construction (the
+    gen-tracked ring pre-assigns slots under one commit thread).
 
 Fault set on top of the harness's seeded sender chaos:
 
@@ -64,7 +72,7 @@ class SamplerChaosConfig:
     script (harness sender chaos + seeded consumer kills + fixed stale
     injection instants)."""
 
-    sample_path: str = "dealer"  # 'dealer' | 'host'
+    sample_path: str = "dealer"  # 'dealer' | 'host' | 'device'
     n_actors: int = 16
     duration_s: float = 6.0
     rows_per_sec: float = 40.0
@@ -86,8 +94,14 @@ class SamplerChaosConfig:
     seed: int = 0
 
     def __post_init__(self):
-        if self.sample_path not in ("dealer", "host"):
+        if self.sample_path not in ("dealer", "host", "device"):
             raise ValueError(f"unknown sample_path {self.sample_path!r}")
+        if self.sample_path == "device" and self.ingest_shards != 1:
+            # the gen-tracked ring pre-assigns slots under ONE commit
+            # thread; coerce rather than raise so the sweep's A/B loop
+            # can run the same config across all three arms (the shard
+            # count difference is structural, not a knob)
+            object.__setattr__(self, "ingest_shards", 1)
 
     def kill_schedule(self) -> list[float]:
         """Seeded consumer-kill offsets (s): even across the middle 80%
@@ -142,10 +156,18 @@ class _SamplerHarness(FleetHarness):
         # generation floor 1: injected frames stamped with generation 0
         # are "pre-restart" retries and must fence at admission (lanes
         # send generation-less frames — they admit as always)
-        service = ReplayService(
-            PrioritizedReplayBuffer(
+        if scfg.sample_path == "device":
+            from d4pg_tpu.replay.fused_buffer import FusedDeviceReplay
+
+            buffer = FusedDeviceReplay(
+                cfg.capacity, cfg.obs_dim, cfg.act_dim, alpha=scfg.alpha,
+                prioritized=True, ingest_shards=1, gen_tracked=True)
+        else:
+            buffer = PrioritizedReplayBuffer(
                 cfg.capacity, cfg.obs_dim, cfg.act_dim,
-                alpha=scfg.alpha, seed=scfg.seed),
+                alpha=scfg.alpha, seed=scfg.seed)
+        service = ReplayService(
+            buffer,
             ingest_capacity=cfg.ingest_capacity,
             heartbeat_timeout=cfg.heartbeat_timeout,
             shed_watermark=cfg.shed_watermark,
@@ -157,6 +179,18 @@ class _SamplerHarness(FleetHarness):
             self._dealer = SampleDealer(
                 cfg.capacity, [self._ring],
                 n_shards=cfg.ingest_shards, k=scfg.k,
+                batch_size=scfg.batch_size, alpha=scfg.alpha,
+                beta_schedule=self._beta,
+                min_size=max(1, scfg.batch_size), seed=scfg.seed,
+                audit=True)
+            service.attach_dealer(self._dealer)
+        elif scfg.sample_path == "device":
+            from d4pg_tpu.replay.device_sampler import DeviceSampleDealer
+            from d4pg_tpu.replay.staging import DeviceDealtBlockRing
+
+            self._ring = DeviceDealtBlockRing(4)
+            self._dealer = DeviceSampleDealer(
+                cfg.capacity, [self._ring], k=scfg.k,
                 batch_size=scfg.batch_size, alpha=scfg.alpha,
                 beta_schedule=self._beta,
                 min_size=max(1, scfg.batch_size), seed=scfg.seed,
@@ -224,8 +258,11 @@ class _SamplerHarness(FleetHarness):
 
     def _spawn_consumer(self, service_ref, stop: threading.Event,
                         inner_stop: threading.Event) -> threading.Thread:
-        target = (self._consume_dealt if self.scfg.sample_path == "dealer"
-                  else self._consume_host)
+        # 'device' blocks ride the same dealt consume lane — the lane is
+        # arm-agnostic (pop + write-back), only the block residency
+        # differs (queue_writeback materializes idx/gen on the host)
+        target = (self._consume_host if self.scfg.sample_path == "host"
+                  else self._consume_dealt)
         t = threading.Thread(target=target,
                              args=(service_ref, stop, inner_stop),
                              daemon=True, name="sampler-consumer")

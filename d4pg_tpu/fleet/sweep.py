@@ -411,10 +411,11 @@ def run_sampler(
 ) -> dict:
     """The bench_fleet sampler block (``fleet/sampler_chaos.py``):
 
-    - **ab**: a fault-free dealer-vs-host pair under the SAME offered
-      load and seed — wire_to_grad p95 on each arm, buffer-lock
-      acquisitions on the consume path (the dealer arm's must be 0 by
-      construction), blocks/s dealt.
+    - **ab**: a fault-free three-arm sweep — host vs dealer vs device
+      (the PR-17 on-device descent) — under the SAME offered load and
+      seed: wire_to_grad / deal_to_grad p95 on each arm, buffer-lock
+      acquisitions on the consume path (the dealer and device arms'
+      must be 0 by construction), blocks/s dealt.
     - **chaos**: one dealer-mode run at ``n_actors`` with the full
       fault set — seeded sender chaos, consumer kills + ring clears,
       shed pressure, stale-generation frame injection — gated by the
@@ -427,7 +428,7 @@ def run_sampler(
     )
 
     ab = {}
-    for path in ("host", "dealer"):
+    for path in ("host", "dealer", "device"):
         r = run_sampler_chaos(
             SamplerChaosConfig(
                 sample_path=path, n_actors=int(n_actors),
@@ -436,6 +437,7 @@ def run_sampler(
             chaos=ChaosConfig(seed=int(seed)))
         ab[path] = {
             "wire_to_grad_p95_ms": r["wire_to_grad_p95_ms"],
+            "deal_to_grad_p95_ms": r["deal_to_grad_p95_ms"],
             "sample_path_buffer_acqs":
                 r["consumer"]["sample_path_buffer_acqs"],
             "blocks_consumed": r["consumer"]["blocks_consumed"],
@@ -445,11 +447,13 @@ def run_sampler(
             "trace_orphans": r["trace_orphans"],
             "sampler": r["sampler"],
         }
-    d, h = (ab["dealer"]["wire_to_grad_p95_ms"],
-            ab["host"]["wire_to_grad_p95_ms"])
-    ab["wire_to_grad_p95_delta_ms"] = (round(d - h, 3)
-                                       if d is not None and h is not None
-                                       else None)
+    h = ab["host"]["wire_to_grad_p95_ms"]
+    for path in ("dealer", "device"):
+        d = ab[path]["wire_to_grad_p95_ms"]
+        ab[path]["wire_to_grad_p95_delta_ms"] = (
+            round(d - h, 3) if d is not None and h is not None else None)
+    # legacy top-level delta (dealer vs host) kept for old readers
+    ab["wire_to_grad_p95_delta_ms"] = ab["dealer"]["wire_to_grad_p95_delta_ms"]
     chaos_row = run_sampler_chaos(SamplerChaosConfig(
         sample_path="dealer", n_actors=int(n_actors),
         duration_s=float(duration_s), learner_kills=int(learner_kills),

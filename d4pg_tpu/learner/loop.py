@@ -157,6 +157,16 @@ class DealtLoop:
     happened on the commit thread, and the write-back only enqueues
     under the ``sampler`` tier. ``stop`` (an ``Event``) lets the owning
     replica abandon a blocked pop mid-round on kill.
+
+    Device-dealt blocks (``replay/device_sampler.DeviceSampleDealer``)
+    arrive with ``batches``/``weights``/``idx``/``gen`` as DEVICE
+    arrays: the rows feed ``update_fn`` with no host round-trip, and
+    the loop materializes only ``idx``/``gen`` (``[K, B]`` int arrays,
+    not sampled rows) on the host at write-back time — the one
+    deliberate D2H on the grad side, synced here so the cost is
+    attributed to the write-back and not hidden inside the dealer's
+    settle. ``td_error`` comes back from the update anyway; the same
+    ``np.asarray`` covers both paths.
     """
 
     def __init__(self, update_fn, ring, service, *,
@@ -190,9 +200,13 @@ class DealtLoop:
             state, metrics = self._update(
                 state, block.batches, block.weights)
             td = np.abs(np.asarray(metrics["td_error"])) + 1e-6
-            self._service.queue_writeback(block.idx, td, block.gen)
+            # One explicit host sync for device-dealt blocks (no-op
+            # copies for host blocks): [K, B] ints, never sampled rows.
+            idx = np.asarray(block.idx)
+            gen = np.asarray(block.gen)
+            self._service.queue_writeback(idx, td, gen)
             _trace_recorder.mark_grad()
-            k = int(block.idx.shape[0])
+            k = int(idx.shape[0])
             done += k
             self.steps_done += k
             self.blocks += 1

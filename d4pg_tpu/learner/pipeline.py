@@ -84,6 +84,18 @@ class IngestOverlap:
                 f"({owner!r}); the fused ingest handoff is single-consumer "
                 "— release() the current owner first, or use the "
                 "host-sampled path for concurrent learner replicas")
+        dealer = getattr(service, "_dealer", None)
+        if dealer is not None and getattr(dealer, "owns_commit", False):
+            # Device-dealt mode: the attached dealer drains the staged
+            # slot itself inside every ingest's buffer-lock window (the
+            # deal must see the block it just committed). A second
+            # commit/stage driver would interleave with those drains and
+            # corrupt the handoff, so refuse up front instead of racing.
+            raise IngestDispatchError(
+                "ReplayService has a device-dealt sampler attached "
+                f"({type(dealer).__name__}); its commit thread owns the "
+                "ingest dispatch — dealt replicas consume from their "
+                "rings, no IngestOverlap")
         service._ingest_overlap_owner = weakref.ref(self)
         self._service = service
         # busy token, held across each dispatch into the service: plain

@@ -37,7 +37,13 @@ Two sampling modes, chosen by what the replica is given:
   thread's dealer) from its bounded ring and feeds TD priorities back
   through ``service.queue_writeback``. The sample path acquires the
   ring leaf lock and the ``sampler`` tier ONLY — never the buffer
-  lock, which is the whole point.
+  lock, which is the whole point. The replica is agnostic to WHERE
+  the dealer sampled: host blocks (``SampleDealer``, numpy rows) and
+  device blocks (``replay/device_sampler.DeviceSampleDealer``,
+  device-resident gathers that flow into ``update_fn`` with no host
+  round-trip) ride the same ring and the same ``DealtLoop`` — the
+  commit thread owns every device handle in the device-dealt mode, so
+  nothing here changes per variant.
 
 PER beta annealing: with N replicas each replica annealing off its own
 ``steps_done`` would scale the anneal rate with N (the PR-10 defect) —

@@ -1,0 +1,107 @@
+"""Pallas TPU kernel for the PER stratified prefix-sum descent.
+
+The dealt plane's sample step (``replay/device_sampler.py``) is a batch
+of inverse-CDF descents through the device sum tree — a memory-bound
+gather loop with log2(capacity) dependent rounds. The baseline arm keeps
+it as plain ``jnp`` gathers (``device_per.descend``), which XLA lowers to
+one dynamic-gather per level; this kernel is the Pallas arm of the
+``--sampler`` autotune surface (``ops/autotune.select_sampler``): the
+whole sum tree is pinned in VMEM for the duration of a query tile, so
+the log2(N) rounds never re-touch HBM.
+
+TPU VMEM has no vectorized dynamic gather, so each level's
+``left_sum = tree[2 * node]`` is computed as a chunked ONE-HOT
+contraction over the tree: for every tree chunk, ``where(j == left,
+tree_j, 0)`` summed over the chunk. Exactly one summand is nonzero and
+float32 ``x + 0.0 == x`` is exact, so the result is BITWISE the gathered
+value — the kernel and the ``jnp`` descent arm agree bit-for-bit, which
+is what lets the seeded-stream oracle pin either arm against the host
+dealer (tests/test_devsample.py).
+
+Fit bound: the tree block is ``2 * capacity`` float32 in VMEM (~16 MB
+per core), so capacity ≲ 1.5M slots — above that the kernel refuses and
+the autotuner falls back to the ``jnp`` arm. Runs under
+``interpret=True`` on CPU for tests; on CPU the autotuner never selects
+it (interpret mode measures the emulator, not a kernel — same policy as
+``ops/projection.py``, which is also honest about losing its race: the
+one-hot contraction does O(capacity) work per level against the
+gather's O(1), so this arm only wins where VMEM residency beats HBM
+gather latency, an empirical fact ``--sampler auto`` measures on chip).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+from jax.experimental import pallas as pl
+
+_TILE_Q = 128  # queries per grid step
+_CHUNK = 512  # tree nodes per one-hot contraction round
+
+# VMEM budget for the resident tree block (bytes); past this the caller
+# must use the jnp gather arm (pallas_fits / select_sampler gate it).
+_VMEM_TREE_BYTES = 12 * 1024 * 1024
+
+
+def pallas_fits(capacity: int) -> bool:
+    """Whether the [2 * capacity] float32 tree block fits the VMEM budget."""
+    return 2 * int(capacity) * 4 <= _VMEM_TREE_BYTES
+
+
+def _descent_kernel(tree_ref, mass_ref, idx_ref, *, levels, cap):
+    p = mass_ref[:]  # [TQ]
+    node = jnp.ones(p.shape, jnp.int32)
+    tree = tree_ref[:]  # [2 * cap], VMEM-resident across all levels
+    for _ in range(levels):
+        left = node * 2
+        # one-hot gather of tree[left], chunked so the [TQ, chunk]
+        # compare/select temporary stays small; only the hit chunk
+        # contributes a nonzero summand (bitwise-exact, see module doc)
+        left_sum = jnp.zeros(p.shape, jnp.float32)
+        for c0 in range(0, 2 * cap, _CHUNK):
+            c = min(_CHUNK, 2 * cap - c0)
+            j = c0 + jax.lax.broadcasted_iota(jnp.int32, (p.shape[0], c), 1)
+            hit = j == left[:, None]
+            left_sum = left_sum + jnp.sum(
+                jnp.where(hit, tree[c0:c0 + c][None, :], 0.0), axis=1)
+        # the shared tie rule (device_per.descend): mass >= left sum
+        # descends RIGHT — left is even, so ``left + 1`` is ``left | 1``
+        go_right = p >= left_sum
+        p = jnp.where(go_right, p - left_sum, p)
+        node = jnp.where(go_right, left + 1, left)
+    idx_ref[:] = node - cap
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def descend_pallas(sum_tree: Array, mass: Array,
+                   interpret: bool = False) -> Array:
+    """Drop-in Pallas variant of ``device_per.descend`` (flat queries).
+
+    sum_tree: [2 * capacity] float32; mass: [Q] float32 prefix masses.
+    Q pads up to the query tile internally; [Q] int32 slots come back
+    exact and bitwise-equal to the jnp descent arm.
+    """
+    cap = sum_tree.shape[0] // 2
+    levels = int(math.log2(cap))  # jaxlint: disable=host-sync-in-jit (shape: static under jit)
+    q = mass.shape[0]
+    pad = (-q) % _TILE_Q
+    m = jnp.pad(mass.astype(jnp.float32), (0, pad))
+    total_q = q + pad
+
+    kernel = functools.partial(_descent_kernel, levels=levels, cap=cap)
+    idx = pl.pallas_call(
+        kernel,
+        grid=(total_q // _TILE_Q,),
+        in_specs=[
+            pl.BlockSpec((2 * cap,), lambda i: (0,)),
+            pl.BlockSpec((_TILE_Q,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((_TILE_Q,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((total_q,), jnp.int32),
+        interpret=interpret,
+    )(sum_tree, m)
+    return idx[:q]

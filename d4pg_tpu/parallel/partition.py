@@ -47,6 +47,8 @@ __all__ = [
     "match_partition_rules", "format_rules", "spec", "sharding",
     "replicated", "batch_sharding", "stacked_sharding", "replica_sharding",
     "batch_spec", "replicated_spec", "stacked_spec", "replica_spec",
+    "per_tree_spec", "dealt_block_spec", "per_tree_sharding",
+    "dealt_block_sharding",
     "data_spec", "shardings_for", "state_specs", "state_shardings",
     "replica_stack_shardings", "make_shard_and_gather_fns",
     "named_flat", "named_unflat",
@@ -95,6 +97,26 @@ def replica_spec() -> PS:
     return PS(REPLICA_AXIS)
 
 
+def per_tree_spec() -> PS:
+    """[2·cap] device PER sum/min trees (``replay/device_per.PerTrees``):
+    REPLICATED. The stratified descent is a root-to-leaf pointer chase —
+    every query touches every level, so splitting the tree over any mesh
+    axis would turn each of the log2(cap) gathers into a collective.
+    Keeping the tree replicated keeps the jitted deal dispatch at zero
+    all-to-alls (the ReshardSentinel pin in bench.py's device-dealt
+    block) at a memory cost of 8 bytes/slot/device."""
+    return PS()
+
+
+def dealt_block_spec() -> PS:
+    """[K, B, ...] device-dealt gathers (rows, weights, idx, gen out of
+    ``DeviceSampleDealer.deal_fn``): same layout as the chunk stacks
+    they feed — K replicated (the scan axis), B split over ``data``.
+    With the tree replicated (``per_tree_spec``) the gather itself needs
+    no resharding to land here."""
+    return stacked_spec()
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, replicated_spec())
 
@@ -109,6 +131,14 @@ def stacked_sharding(mesh: Mesh) -> NamedSharding:
 
 def replica_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, replica_spec())
+
+
+def per_tree_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, per_tree_spec())
+
+
+def dealt_block_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, dealt_block_spec())
 
 
 # --------------------------------------------------------------------------

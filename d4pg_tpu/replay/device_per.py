@@ -130,6 +130,53 @@ def update_from_td(
     )
 
 
+def strata_mass(u: Array, total: Array) -> Array:
+    """Stratified prefix masses from unit uniforms ``u`` ([..., B]):
+    stratum ``i`` draws mass ``(i + u_i) * (total / B)``. Factored out so
+    the host twin oracle (``sampler.SampleDealer`` in ``dtype='float32'``
+    mode) can reproduce the exact float32 arithmetic with numpy — add,
+    divide and multiply are correctly-rounded IEEE ops, bitwise identical
+    between numpy and XLA CPU (unlike ``**``, see :func:`block_weights`)."""
+    b = u.shape[-1]
+    return (jnp.arange(b) + u) * (total / b)
+
+
+def descend(sum_tree: Array, mass: Array) -> Array:
+    """Lock-step inverse-CDF descent of prefix masses ``mass`` (any
+    shape) through ``sum_tree`` ([2 * capacity]); returns leaf slots.
+
+    TIE RULE (the bitwise-oracle contract, shared with the host trees'
+    ``segment_tree.SumTree.find_prefixsum`` / ``ShardSlicePerTrees``): at
+    every node, ``mass >= left_subtree_sum`` descends RIGHT (and
+    subtracts); strictly less descends left. A prefix equal to a left
+    subtree's sum therefore always resolves to the first leaf of the
+    RIGHT subtree — in particular a zero-mass query at a zero-priority
+    left leaf skips to the first nonzero leaf, and duplicate prefix
+    values (two strata colliding after float rounding) resolve to the
+    same slot on host and device alike."""
+    cap = sum_tree.shape[0] // 2
+    p = mass
+    node = jnp.ones(mass.shape, jnp.int32)
+    for _ in range(_levels(cap)):
+        left = node << 1
+        left_sum = sum_tree[left]
+        go_right = p >= left_sum
+        p = jnp.where(go_right, p - left_sum, p)
+        node = jnp.where(go_right, left | 1, left)
+    return node - cap
+
+
+def sample_from_uniforms(trees: PerTrees, u: Array, limit: Array) -> Array:
+    """Stratified proportional sampling from caller-supplied unit
+    uniforms ``u`` ([..., B]) — the descent half of :func:`sample`, split
+    out so the dealt plane can feed uniforms drawn from the dealer's
+    seeded HOST stream (the bitwise-oracle stream) instead of a device
+    PRNG key. ``limit`` clips prefix overshoot onto written leaves."""
+    total = trees.sum_tree[1]
+    idx = descend(trees.sum_tree, strata_mass(u, total))
+    return jnp.minimum(idx, jnp.maximum(limit - 1, 0))
+
+
 def sample(
     trees: PerTrees, key: Array, batch_size: int, limit: Array
 ) -> Array:
@@ -137,18 +184,8 @@ def sample(
     uniform draw each, lock-step inverse-CDF descent (the vectorized form
     of ``prioritized_replay_memory.py:258-265``). ``limit`` (traced int,
     the buffer's live size) clips prefix overshoot onto written leaves."""
-    total = trees.sum_tree[1]
     u = jax.random.uniform(key, (batch_size,))
-    p = (jnp.arange(batch_size) + u) * (total / batch_size)
-    node = jnp.ones(batch_size, jnp.int32)
-    for _ in range(_levels(trees.capacity)):
-        left = node << 1
-        left_sum = trees.sum_tree[left]
-        go_right = p >= left_sum
-        p = jnp.where(go_right, p - left_sum, p)
-        node = jnp.where(go_right, left | 1, left)
-    idx = node - trees.capacity
-    return jnp.minimum(idx, jnp.maximum(limit - 1, 0))
+    return sample_from_uniforms(trees, u, limit)
 
 
 def is_weights(
@@ -162,6 +199,39 @@ def is_weights(
     max_weight = (p_min * n) ** (-beta)
     p = trees.sum_tree[trees.capacity + idx] / total
     return ((p * n) ** (-beta) / max_weight).astype(jnp.float32)
+
+
+def block_weights(
+    total: Array, min_root: Array, leaf_p: Array, beta: Array, size: Array
+) -> Array:
+    """IS weights for a dealt block from its tree scalars and gathered
+    leaf priorities — the float32 mirror of the host dealer's
+    ``_draw_block_locked`` weight expression (``weight_base`` +
+    ``(p * N) ** -beta / max_weight``).
+
+    Kept as ONE shared function because float32 ``**`` is NOT bitwise
+    portable between numpy and XLA (measured 1-ulp divergence on CPU):
+    the device deal dispatch and the host twin oracle both call the SAME
+    compiled transform (:func:`block_weights_jitted`), so the oracle's
+    weight comparison is exact by construction instead of hostage to
+    libm rounding."""
+    n = size.astype(jnp.float32)
+    z = min_root / total * n
+    max_weight = z ** (-beta)
+    p = leaf_p / total
+    return ((p * n) ** (-beta) / max_weight).astype(jnp.float32)
+
+
+_block_weights_jit = None
+
+
+def block_weights_jitted(total, min_root, leaf_p, beta, size) -> Array:
+    """Dispatch :func:`block_weights` as one cached jit — the single
+    compiled artifact both the device dealer and the twin oracle share."""
+    global _block_weights_jit
+    if _block_weights_jit is None:
+        _block_weights_jit = jax.jit(block_weights)
+    return _block_weights_jit(total, min_root, leaf_p, beta, size)
 
 
 _set_leaves_jit = None

@@ -148,6 +148,7 @@ class FusedDeviceReplay:
         block_rows: int | None = None,
         staging_blocks: int = 8,
         ingest_shards: int = 1,
+        gen_tracked: bool = False,
     ):
         self.capacity = int(capacity)
         obs_shape = (obs_dim,) if np.isscalar(obs_dim) else tuple(obs_dim)
@@ -164,6 +165,33 @@ class FusedDeviceReplay:
         self.trees = dper.init(self.capacity) if prioritized else None
         self.size = 0
         self.head = 0
+        # Generation-tracked mode (the device-dealt sample plane,
+        # replay/device_sampler.DeviceSampleDealer): ``add`` pre-assigns
+        # and returns slot indices (the dealer drains every staged row to
+        # the device inside the same buffer-lock window, so assignment
+        # order IS commit order), a host int64 generation mirror fences
+        # priority write-backs, and the fused commit additionally bumps a
+        # device int32 generation array so the deal dispatch can snapshot
+        # sampled generations without a host sync. Tree VALUES stay
+        # host-computed (``p_ins = max_priority ** alpha`` in float64,
+        # cast float32): float32 ``**`` is not bitwise portable between
+        # numpy and XLA, and keeping the pow on the host is what makes
+        # the device trees bitwise-equal to the float32 host twin oracle.
+        self.gen_tracked = bool(gen_tracked)
+        if self.gen_tracked:
+            if not self.prioritized:
+                raise ValueError("gen_tracked needs prioritized=True "
+                                 "(it exists for the PER dealt plane)")
+            if int(ingest_shards) > 1:
+                raise ValueError(
+                    "gen_tracked needs ingest_shards=1: direct-staged "
+                    "shard rows bypass add(), which owns slot assignment")
+            import jax.numpy as jnp
+
+            self.max_priority = 1.0
+            self.generation = np.zeros(self.capacity, np.int64)
+            self.gen = jnp.zeros(self.capacity, jnp.int32)
+            self._next_slot = 0
         obs_dtype = np.dtype(obs_dtype)
         # staging covers ~one ring (small buffers) capped at
         # ``staging_blocks`` blocks (big ones): deeper backlogs would only
@@ -197,6 +225,33 @@ class FusedDeviceReplay:
         if not self.prioritized:
             return jax.jit(write, donate_argnums=(0,))
 
+        if self.gen_tracked:
+            from d4pg_tpu.replay.segment_tree import next_pow2
+
+            # pads park at the TREE capacity (>= ring capacity): dropped
+            # by set_leaves' idx < capacity guard AND out of bounds for
+            # the [capacity] generation array, so one pad value silences
+            # both scatters. (The non-tracked path's repeat-the-first-
+            # slot pad would bump that slot's generation spuriously.)
+            padcap = next_pow2(capacity)
+
+            @partial(jax.jit, donate_argnums=(0, 1, 2))
+            def commit_tracked(storage, trees, gen, frame, start, n,
+                               p_ins, max_pri):
+                storage = write(storage, frame, start, n)
+                row = jax.lax.iota(jnp.int32, block)
+                idx = jnp.where(row < n, (start + row) % capacity, padcap)
+                # p_ins is max_priority ** alpha computed on the HOST
+                # (float64 pow, cast f32) — see the gen_tracked note in
+                # __init__; the trees only ever see host-rounded values
+                trees = dper.set_leaves(
+                    trees, idx, jnp.full((block,), p_ins, jnp.float32))
+                trees = trees._replace(max_priority=max_pri)
+                gen = gen.at[idx].add(1, mode="drop")
+                return storage, trees, gen
+
+            return commit_tracked
+
         @partial(jax.jit, donate_argnums=(0, 1))
         def commit(storage, trees, frame, start, n):
             storage = write(storage, frame, start, n)
@@ -211,19 +266,39 @@ class FusedDeviceReplay:
         return commit
 
     # -- ingest side (any thread, under the service's buffer lock) ---------
-    def add(self, batch: TransitionBatch) -> None:
+    def add(self, batch: TransitionBatch):
         """Stage host rows into the preallocated column-major staging ring;
         cheap (slice copies — no device work, no jit dispatch). Staging is
         bounded: if the learner pauses (long eval, checkpoint) while actors
         keep streaming, the oldest staged rows are dropped — they would
         only be overwritten by the next drain anyway, and an unbounded
-        backlog could otherwise OOM the host."""
-        if batch.obs.shape[0] == 0:
-            return
+        backlog could otherwise OOM the host.
+
+        In ``gen_tracked`` mode ``add`` also PRE-ASSIGNS the rows' ring
+        slots (returned as the insert indices the dealer mirrors) and
+        bumps their host generations. Assignment order is commit order
+        because the device dealer drains the staging ring inside the
+        same buffer-lock window as this call — enforced by refusing the
+        silent oldest-drop that would desynchronize slots from rows."""
+        n = batch.obs.shape[0]
+        if n == 0:
+            return np.empty(0, np.int64) if self.gen_tracked else None
+        if self.gen_tracked:
+            if len(self._staging) + n > self._staging.size:
+                raise RuntimeError(
+                    "gen_tracked staging overflow: the dealer must drain "
+                    "every add within its buffer-lock window (backlog "
+                    f"{len(self._staging)} + {n} > {self._staging.size})")
+            slots = (self._next_slot + np.arange(n)) % self.capacity
+            self._next_slot = int((self._next_slot + n) % self.capacity)
+            self.generation[slots] += 1
+            self._staging.push(batch)
+            return slots
         if self.ingest_shards > 1:
             self._staging.push(batch, shard=0)
         else:
             self._staging.push(batch)
+        return None
 
     def add_sharded(self, batch: TransitionBatch, shard: int,
                     ticket: int | None = None) -> None:
@@ -288,7 +363,14 @@ class FusedDeviceReplay:
         frame, n = self._inflight
         self._inflight = None
         start = np.int32(self.head)
-        if self.trees is not None:
+        if self.gen_tracked:
+            # host-f64 pow, f32 cast: the trees only see host-rounded
+            # values (bitwise twin contract — see __init__)
+            p_ins = np.float32(self.max_priority ** self.alpha)
+            storage, self.trees, self.gen = self._commit(
+                self._store.arrays, self.trees, self.gen, frame, start,
+                np.int32(n), p_ins, np.float32(self.max_priority))
+        elif self.trees is not None:
             storage, self.trees = self._commit(
                 self._store.arrays, self.trees, frame, start, np.int32(n))
         else:
@@ -300,6 +382,16 @@ class FusedDeviceReplay:
         REGISTRY.counter("fused.rows_committed").inc(n)
         REGISTRY.counter("fused.blocks_committed").inc()
         return n
+
+    # priority write-back for the dealt plane: reached from the device
+    # dealer's settle inside the commit thread's buffer-lock window
+    def apply_priorities(self, idx, p_alpha) -> None:  # jaxlint: guarded-by=_buffer_lock
+        """Scatter settled write-back priorities (already ``** alpha``,
+        float32) into the device trees: ONE jitted dispatch, trees
+        donated (commit thread is the single owner). ``idx`` rows equal
+        to the TREE capacity are pads and are dropped — the dealer pads
+        to fixed buckets so steady state never recompiles."""
+        self.trees = dper.set_leaves_jitted(self.trees, idx, p_alpha)
 
     def drain(self) -> int:
         """Flush ALL staged rows to the device (stage + commit per block
@@ -355,7 +447,12 @@ class FusedDeviceReplay:
             cap = self.trees.capacity
             d["leaf_priorities"] = np.asarray(
                 self.trees.sum_tree[cap:cap + self.size])
-            d["max_priority"] = float(self.trees.max_priority)
+            # gen-tracked: the HOST scalar is authoritative (write-back
+            # settles raise it between commits; the device copy only
+            # refreshes at the next commit dispatch)
+            d["max_priority"] = (float(self.max_priority)
+                                 if self.gen_tracked
+                                 else float(self.trees.max_priority))
         return d
 
     def snapshot(self) -> dict:
@@ -399,3 +496,13 @@ class FusedDeviceReplay:
                     jnp.asarray(d["leaf_priorities"], jnp.float32))
             self.trees = trees._replace(
                 max_priority=jnp.float32(d.get("max_priority", 1.0)))
+        if self.gen_tracked:
+            # restore opens a fresh generation epoch: live rows at 1,
+            # everything else 0, host mirror and device copy in lockstep
+            # — any block dealt against the pre-restore state carries
+            # generations that no longer match and is fenced at settle
+            self.max_priority = float(d.get("max_priority", 1.0))
+            self._next_slot = self.head
+            self.generation = np.zeros(self.capacity, np.int64)
+            self.generation[:self.size] = 1
+            self.gen = jnp.asarray(self.generation, jnp.int32)

@@ -97,7 +97,7 @@ class ShardSlicePerTrees:
     """
 
     def __init__(self, capacity: int, n_slices: int,
-                 backend: str = "auto"):
+                 backend: str = "auto", dtype=np.float64):
         self.capacity = next_pow2(int(capacity))
         self.n_slices = min(next_pow2(max(1, int(n_slices))), self.capacity)
         self.slice_cap = self.capacity // self.n_slices
@@ -107,6 +107,18 @@ class ShardSlicePerTrees:
         if backend not in ("auto", "numpy"):
             raise ValueError(f"unknown ShardSlicePerTrees backend "
                              f"{backend!r} (want 'auto' or 'numpy')")
+        # float32 mode is the DEVICE-TWIN: every leaf value, aggregate
+        # and descent compare rounds exactly like the float32 device
+        # trees (device_per.PerTrees) — f32 add/sub are correctly-rounded
+        # IEEE ops, identical between numpy and XLA — so the twin's
+        # sampled slots are bitwise the device descent's. The native C++
+        # backing is float64-only and is bypassed in this mode.
+        self.dtype = np.dtype(dtype)
+        if self.dtype not in (np.dtype(np.float64), np.dtype(np.float32)):
+            raise ValueError("ShardSlicePerTrees dtype must be float64 "
+                             f"or float32, got {self.dtype}")
+        if self.dtype == np.float32:
+            backend = "numpy"
         self._native_cls = None
         if backend == "auto":
             try:
@@ -125,10 +137,10 @@ class ShardSlicePerTrees:
             self._native = self._native_cls(self.capacity)
             return
         s = self.n_slices
-        self._sum = np.zeros((s, self._stride), np.float64)
-        self._min = np.full((s, self._stride), np.inf, np.float64)
-        self._top = np.zeros(2 * s, np.float64)
-        self._top_min = np.full(2 * s, np.inf, np.float64)
+        self._sum = np.zeros((s, self._stride), self.dtype)
+        self._min = np.full((s, self._stride), np.inf, self.dtype)
+        self._top = np.zeros(2 * s, self.dtype)
+        self._top_min = np.full(2 * s, np.inf, self.dtype)
 
     def set(self, idx: np.ndarray, values: np.ndarray) -> None:
         """Batched leaf assignment + ancestor repair, the `_Tree.set`
@@ -137,7 +149,7 @@ class ShardSlicePerTrees:
             self._native.set(idx, values)
             return
         idx = np.asarray(idx, np.int64).ravel()
-        values = np.asarray(values, np.float64).ravel()
+        values = np.asarray(values, self.dtype).ravel()
         sl = idx // self.slice_cap
         node = (idx % self.slice_cap) + self.slice_cap
         self._sum[sl, node] = values
@@ -206,7 +218,9 @@ class ShardSlicePerTrees:
         the returned slots match the single tree bitwise."""
         if self._native is not None:
             return self._native.find_prefixsum(prefix)
-        p = np.asarray(prefix, np.float64).copy()
+        # descend in the tree's own dtype: in float32 (device-twin) mode
+        # every compare/subtract rounds exactly like the device descent
+        p = np.asarray(prefix, self.dtype).copy()
         node = np.ones_like(p, dtype=np.int64)
         for _ in range(self._top_levels):
             left = node << 1
@@ -264,9 +278,23 @@ class SampleDealer:
                  batch_size: int, alpha: float = 0.6,
                  beta_schedule: SharedBetaSchedule | None = None,
                  min_size: int = 1, seed: int = 0, ring_capacity: int = 4,
-                 max_deals_per_tick: int = 1, audit: bool = False):
+                 max_deals_per_tick: int = 1, audit: bool = False,
+                 scheme: str = "legacy"):
+        if scheme not in ("legacy", "device"):
+            raise ValueError(f"unknown SampleDealer scheme {scheme!r} "
+                             "(want 'legacy' or 'device')")
+        # scheme='device' is the DEVICE-TWIN oracle (tests only): float32
+        # trees + the device stratification ((i + u) * total / B from
+        # unit uniforms) + the shared jitted weight transform — every
+        # draw is bitwise what replay/device_sampler.DeviceSampleDealer
+        # produces from the same seed. Both schemes consume exactly B
+        # doubles of the seeded stream per strata draw, so pause/resume
+        # lockstep works across schemes unchanged.
+        self.scheme = scheme
         self._sampler_lock = TieredLock("sampler")
-        self._trees = ShardSlicePerTrees(capacity, n_shards)
+        self._trees = ShardSlicePerTrees(
+            capacity, n_shards,
+            dtype=np.float32 if scheme == "device" else np.float64)
         self._n_shards = max(1, int(n_shards))
         self._rings = list(rings)
         self.k = int(k)
@@ -296,6 +324,7 @@ class SampleDealer:
         self._tid_of = np.zeros(cap, np.uint64)  # trace ids are u64 on the wire
         self._ins_seq = np.zeros(cap, np.int64)
         self._ins_counter = 0
+        self._last_tid = 0  # newest insert's trace id (device deal span)
         self._wb = [deque() for _ in range(self._trees.n_slices)]
         self._wb_depth = 0
         self._wb_lag = REGISTRY.histogram("sampler.writeback_lag_ms")
@@ -339,8 +368,10 @@ class SampleDealer:
                 self._tid_of[idx] = 0 if tid is None else int(tid)
                 self._ins_counter += 1
                 self._ins_seq[idx] = self._ins_counter
-                p = self.max_priority ** self.alpha
-                self._trees.set(idx, np.full(len(idx), p))
+                if tid:
+                    self._last_tid = int(tid)
+                self._apply_insert_locked(idx)
+            self._post_ingest_locked(buffer)
             self._size = int(buffer.size)
             # settle-then-draw inside one critical section: every draw
             # sees all write-backs queued before this tick, mirroring the
@@ -364,6 +395,21 @@ class SampleDealer:
                         dealt.append((ri, blk))
             self.deal_busy_s += time.monotonic() - t0
         return dealt
+
+    def _apply_insert_locked(self, idx: np.ndarray) -> None:
+        """Land one insert's priorities in the trees this dealer reads.
+        Host dealer: mirror into the slice trees at the entry priority.
+        The device dealer overrides this to a no-op — its priorities land
+        in the DEVICE trees via the fused commit (``_post_ingest_locked``
+        drains the buffer), not in a host mirror."""
+        p = self.max_priority ** self.alpha
+        self._trees.set(idx, np.full(len(idx), p))
+
+    def _post_ingest_locked(self, buffer) -> None:
+        """Hook between the insert mirror and the settle: the device
+        dealer lands every staged row on the device here (same lock
+        window as the ``buffer.add`` calls, which is what makes slot
+        pre-assignment order equal commit order)."""
 
     def publish(self, dealt) -> None:
         """Push dealt blocks into their rings and stamp each block's
@@ -389,11 +435,26 @@ class SampleDealer:
         t = self._beta.current_step()
         beta = self._beta.beta_at(t)
         idx = np.stack([self._sample_idx_locked(size) for _ in range(self.k)])
-        max_weight = z ** (-beta)
-        w = []
-        for i in range(self.k):
-            p = self._trees.get(idx[i]) / total
-            w.append(((p * size) ** (-beta) / max_weight).astype(np.float32))
+        if self.scheme == "device":
+            # the SAME compiled float32 transform the device dealer
+            # dispatches (device_per.block_weights_jitted): float32 ``**``
+            # differs by 1 ulp between numpy and XLA, so sharing the
+            # compiled artifact is the only way the oracle's weight
+            # comparison can be exact rather than approximate
+            from d4pg_tpu.replay import device_per as dper
+
+            w = np.asarray(dper.block_weights_jitted(
+                np.float32(total), np.float32(self._trees.min()),
+                self._trees.get(idx).astype(np.float32),
+                np.float32(beta), np.int32(size)))
+        else:
+            max_weight = z ** (-beta)
+            w = []
+            for i in range(self.k):
+                p = self._trees.get(idx[i]) / total
+                w.append(((p * size) ** (-beta)
+                          / max_weight).astype(np.float32))
+            w = np.stack(w)
         gen = self._gen[idx].copy()
         if self._audit and self._dead:
             hits = {int(s) for s in self._src_seq[idx.ravel()]} & self._dead
@@ -404,10 +465,23 @@ class SampleDealer:
         self._deal_seq += 1
         self.dealt_blocks += 1
         self.dealt_rows += self.k * self.batch_size
-        return DealtBlock(buffer.gather(idx), np.stack(w), idx, gen,
+        return DealtBlock(buffer.gather(idx), w, idx, gen,
                           beta, t, tid, self._deal_seq)
 
     def _sample_idx_locked(self, size: int) -> np.ndarray:
+        if self.scheme == "device":
+            # device stratification from unit uniforms, float32 end to
+            # end — numpy's f32 add/div/mul round exactly like XLA's, so
+            # these masses (and the f32 descent they feed) are bitwise
+            # the device deal dispatch's (device_per.strata_mass). The
+            # stream cost is B doubles, same as the legacy draw.
+            b = self.batch_size
+            u = self._rng.uniform(0.0, 1.0, b).astype(np.float32)
+            total = np.float32(self._trees.total())
+            mass = (np.arange(b, dtype=np.float32) + u) * (
+                total / np.float32(b))
+            idx = self._trees.find_prefixsum(mass)
+            return np.minimum(idx, max(size - 1, 0))
         # PrioritizedReplayBuffer.sample_idx, stratified scheme, verbatim
         total = self._trees.total()
         bounds = np.linspace(0.0, total, self.batch_size + 1)
@@ -509,6 +583,7 @@ class SampleDealer:
             self._src_seq.fill(-1)
             self._tid_of.fill(0)
             self._ins_seq.fill(0)
+            self._last_tid = 0
             if self._size:
                 live = np.arange(self._size)
                 # leaves already hold priority ** alpha (state_dict note)

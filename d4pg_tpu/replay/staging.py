@@ -308,3 +308,36 @@ class DealtBlockRing:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
+
+
+class DeviceDealtBlockRing(DealtBlockRing):
+    """``DealtBlockRing`` for DEVICE-resident dealt blocks
+    (``replay/device_sampler.DeviceSampleDealer``): queue mechanics are
+    identical, but ``clear`` — the replica-kill / restore path — also
+    explicitly ``delete()``s each dropped block's device buffers. A
+    host block's rows are reclaimed by the GC the moment the ring drops
+    its reference; a device block's rows are HBM that would otherwise
+    linger until the next GC cycle, so a kill burst could transiently
+    hold ring_capacity * K * B rows of dead sample memory per replica.
+    Deleting eagerly makes clear-on-kill reclaim immediate (pinned by
+    the devsample chaos test).
+    """
+
+    def clear(self) -> int:
+        with self._cond:
+            dropped = list(self._q)
+            self._q.clear()
+            self._cond.notify_all()
+        for block in dropped:
+            for arr in (*block.batches, block.weights, block.idx,
+                        block.gen):
+                delete = getattr(arr, "delete", None)
+                if delete is not None:
+                    try:
+                        delete()
+                    except Exception:
+                        pass  # already consumed/donated elsewhere
+        kick = self.on_room
+        if dropped and kick is not None:
+            kick()
+        return len(dropped)

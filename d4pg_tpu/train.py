@@ -354,6 +354,41 @@ def train(cfg: ExperimentConfig) -> dict:
             raise ValueError(
                 "--replay_storage device with --data_parallel > 1 requires "
                 "the fused path (--fused_replay auto/on)")
+    # Sample-path arm for --sample_on_ingest (ops/autotune.select_sampler,
+    # the third arbitration surface): resolved BEFORE buffer construction
+    # because the device arms ('scan'/'pallas') change what the service
+    # owns — a gen-tracked fused device ring whose commit thread runs the
+    # stratified descent fused behind the commit dispatch, dealing
+    # device-resident blocks. 'host' keeps the PR-12 host SampleDealer
+    # against host replay storage (the fallback arm).
+    dealt_arm = None
+    if cfg.sample_on_ingest and cfg.prioritized_replay:
+        from d4pg_tpu.ops.autotune import select_sampler
+
+        dealt_arm = select_sampler(
+            cfg.sampler, capacity=cfg.memory_size,
+            k=max(1, cfg.updates_per_dispatch),
+            batch_size=cfg.batch_size).selected
+        if dealt_arm in ("scan", "pallas"):
+            if mesh is not None or multi_host:
+                raise ValueError(
+                    "--sampler scan/pallas (device-dealt) makes the commit "
+                    "thread the single owner of every device handle — "
+                    "mesh/multi-host learners need --sampler host")
+            if cfg.ingest_shards != 1:
+                raise ValueError(
+                    "--sampler scan/pallas needs --ingest_shards 1: the "
+                    "gen-tracked ring pre-assigns slots under ONE commit "
+                    "thread (shard it with --sampler host instead)")
+            if cfg.fused_replay == "on":
+                raise ValueError(
+                    "--fused_replay on (the FusedLoop learner) conflicts "
+                    "with --sample_on_ingest: the device-dealt arm owns "
+                    "the commit dispatch itself — drop --fused_replay on")
+            # The learner-side fused path is OFF (replicas consume dealt
+            # blocks); the service's buffer is still a fused device ring,
+            # built gen-tracked below.
+            fused = False
     if fused and mesh is not None:
         from d4pg_tpu.replay.sharded_per import ShardedFusedReplay
 
@@ -379,6 +414,16 @@ def train(cfg: ExperimentConfig) -> dict:
                                    prioritized=cfg.prioritized_replay,
                                    obs_dtype=obs_dtype,
                                    ingest_shards=cfg.ingest_shards)
+    elif dealt_arm in ("scan", "pallas"):
+        from d4pg_tpu.replay.fused_buffer import FusedDeviceReplay
+
+        # the device-dealt service buffer: slots pre-assigned on the
+        # host, priorities/generations committed by the ONE jitted
+        # dispatch, sampled on device by the attached DeviceSampleDealer
+        buffer = FusedDeviceReplay(cfg.memory_size, obs_dim, act_dim,
+                                   alpha=cfg.per_alpha, prioritized=True,
+                                   obs_dtype=obs_dtype, ingest_shards=1,
+                                   gen_tracked=True)
     elif cfg.prioritized_replay:
         buffer = PrioritizedReplayBuffer(cfg.memory_size, obs_dim, act_dim,
                                          alpha=cfg.per_alpha, seed=cfg.seed,
@@ -996,10 +1041,14 @@ def train(cfg: ExperimentConfig) -> dict:
     replica_failures: dict[int, int] = {}
     if cfg.learners > 1 or cfg.sample_on_ingest:
         if fused:
+            # Unreachable for the device-dealt arm (it forces fused=False
+            # above); this guards the FusedLoop learner path proper.
             raise ValueError(
                 "--learners > 1 / --sample_on_ingest need the host-sampled "
-                "replay path (fused device replay is single-consumer by "
-                "construction — pass --fused_replay off)")
+                "replay path (the FusedLoop learner is single-consumer by "
+                "construction — pass --fused_replay off; device-resident "
+                "sampling under --sample_on_ingest is --sampler "
+                "scan/pallas, which owns its fused ring via the dealer)")
         # Merge transport (--agg_transport): 'collective' runs the
         # replicas mesh-native (learner/mesh_replicas.py — full states
         # stacked along the 'replica' mesh axis by partition rule, the
@@ -1077,16 +1126,35 @@ def train(cfg: ExperimentConfig) -> dict:
 
             dealt_rings: list = []
             if cfg.sample_on_ingest:
-                from d4pg_tpu.replay.sampler import SampleDealer
-                from d4pg_tpu.replay.staging import DealtBlockRing
+                if dealt_arm in ("scan", "pallas"):
+                    # device-dealt plane: the dealer runs the stratified
+                    # descent on device fused behind the commit dispatch
+                    # and deals device-resident blocks; rings delete
+                    # dropped device blocks eagerly on clear (kill burst)
+                    from d4pg_tpu.replay.device_sampler import (
+                        DeviceSampleDealer)
+                    from d4pg_tpu.replay.staging import DeviceDealtBlockRing
 
-                dealt_rings = [DealtBlockRing(4) for _ in range(n_learners)]
-                dealer = SampleDealer(
-                    cfg.memory_size, dealt_rings,
-                    n_shards=cfg.ingest_shards, k=K,
-                    batch_size=cfg.batch_size, alpha=cfg.per_alpha,
-                    beta_schedule=beta_sched,
-                    min_size=max(1, cfg.batch_size), seed=cfg.seed)
+                    dealt_rings = [DeviceDealtBlockRing(4)
+                                   for _ in range(n_learners)]
+                    dealer = DeviceSampleDealer(
+                        cfg.memory_size, dealt_rings, k=K,
+                        batch_size=cfg.batch_size, alpha=cfg.per_alpha,
+                        beta_schedule=beta_sched,
+                        min_size=max(1, cfg.batch_size), seed=cfg.seed,
+                        arm=dealt_arm)
+                else:
+                    from d4pg_tpu.replay.sampler import SampleDealer
+                    from d4pg_tpu.replay.staging import DealtBlockRing
+
+                    dealt_rings = [DealtBlockRing(4)
+                                   for _ in range(n_learners)]
+                    dealer = SampleDealer(
+                        cfg.memory_size, dealt_rings,
+                        n_shards=cfg.ingest_shards, k=K,
+                        batch_size=cfg.batch_size, alpha=cfg.per_alpha,
+                        beta_schedule=beta_sched,
+                        min_size=max(1, cfg.batch_size), seed=cfg.seed)
                 service.attach_dealer(dealer)
             aggregator = Aggregator(
                 weights, mode=cfg.agg_mode, clip=cfg.agg_clip,
@@ -1114,7 +1182,9 @@ def train(cfg: ExperimentConfig) -> dict:
                     beta_schedule=beta_sched))
             print(f"learner plane: {n_learners} replicas, "
                   f"mode={cfg.agg_mode} clip={cfg.agg_clip} "
-                  f"sample_on_ingest={cfg.sample_on_ingest}", flush=True)
+                  f"sample_on_ingest={cfg.sample_on_ingest}"
+                  + (f" sampler={dealt_arm}" if dealt_arm else ""),
+                  flush=True)
 
     def train_steps_multi(n: int):
         """Fan the cycle's n grad steps across the replicas: each runs

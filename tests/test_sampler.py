@@ -459,9 +459,10 @@ def test_sampler_chaos_smoke():
 @pytest.mark.obs
 def test_fleet_artifact_sampler_schema():
     """The newest committed fleet artifact must carry the sampler block:
-    the dealer-vs-host A/B pair (the dealer consume path pinned at ZERO
-    buffer-lock acquisitions, wire-to-grad p95 on both arms) and one
-    dealer chaos row passing every gating oracle — a later PR that
+    the three-arm A/B sweep — host vs dealer vs device (the on-device
+    descent), the dealer/device consume paths pinned at ZERO buffer-lock
+    acquisitions, wire-to-grad AND deal-to-grad p95 on every arm — and
+    one dealer chaos row passing every gating oracle. A later PR that
     drops any of it fails tier-1 here."""
     arts = sorted(glob.glob(os.path.join(
         REPO_ROOT, "docs", "evidence", "fleet", "fleet_*.json")))
@@ -473,14 +474,18 @@ def test_fleet_artifact_sampler_schema():
     assert blk["metric"] == "fleet_sampler" and blk["schema"] == 1
     ab = blk["ab"]
     assert ab["dealer"]["sample_path_buffer_acqs"] == 0
+    assert ab["device"]["sample_path_buffer_acqs"] == 0
     assert ab["host"]["sample_path_buffer_acqs"] > 0
-    for arm in ("dealer", "host"):
+    for arm in ("dealer", "host", "device"):
         assert ab[arm]["wire_to_grad_p95_ms"] is not None
+        assert "deal_to_grad_p95_ms" in ab[arm]
         assert ab[arm]["blocks_consumed"] > 0
         assert ab[arm]["deadlocks"] == 0
         assert ab[arm]["hierarchy_violations"] == 0
         assert ab[arm]["trace_orphans"] == 0
-    assert ab["dealer"]["sampler"]["dealt_blocks"] > 0
+    for arm in ("dealer", "device"):
+        assert ab[arm]["sampler"]["dealt_blocks"] > 0
+        assert "wire_to_grad_p95_delta_ms" in ab[arm]
     chaos = blk["chaos"]
     assert chaos["metric"] == "sampler_chaos" and chaos["schema"] == 1
     assert chaos["sample_path"] == "dealer"
