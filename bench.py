@@ -518,6 +518,7 @@ def bench_fleet(ns=(8, 32, 64, 128, 256), duration_s: float = 10.0,
     from d4pg_tpu.fleet.chaos import ChaosConfig
     from d4pg_tpu.fleet.sweep import (
         default_chaos,
+        run_elastic,
         run_learners,
         run_recovery,
         run_sampler,
@@ -586,6 +587,16 @@ def bench_fleet(ns=(8, 32, 64, 128, 256), duration_s: float = 10.0,
     artifact["sampler"] = run_sampler(
         n_actors=max(64, min(ns)), duration_s=min(duration_s, 6.0),
         seed=seed, learner_kills=2, stale_frames=8)
+    # elastic block: the flash-crowd autoscaler-on/off A/B drill at equal
+    # seeded offered load (fleet/elastic_chaos.py) — serving SLO breaches
+    # and ingest shed rows per arm (the autoscaler arm must be strictly
+    # better on BOTH), per-class shed attribution, the scaling-decision
+    # ledger with its bit-identical replay oracle, and the offered-load
+    # determinism probe. Safe in this parent: run_serving above already
+    # initialized the single-core CPU backend this block shares.
+    # Schema-checked in tier-1 (tests/test_elastic.py) like the blocks
+    # above.
+    artifact["elastic"] = run_elastic(seed=seed)
     # mesh-learners block: the socket-vs-collective aggregation A/B at
     # equal offered load (fleet/mesh_ab.py) — updates/s each arm and
     # per-round aggregation latency p50/p95 per replica count. The only
@@ -909,6 +920,19 @@ def main():
             json.dump(artifact, f, indent=2)
         prune_artifacts(evidence, "fleet_",
                         int(os.environ.get("D4PG_FLEET_KEEP", "8")))
+        # the elastic block also lands standalone under evidence/elastic/
+        # (docs/README table + tests/test_elastic.py read it without
+        # parsing the full fleet artifact), same stamp+pid+prune scheme
+        if "elastic" in artifact:
+            elastic_dir = os.path.join(
+                os.path.dirname(evidence), "elastic")
+            os.makedirs(elastic_dir, exist_ok=True)
+            with open(os.path.join(
+                    elastic_dir,
+                    f"elastic_{stamp}_{os.getpid():07d}.json"), "w") as f:
+                json.dump(artifact["elastic"], f, indent=2)
+            prune_artifacts(elastic_dir, "elastic_",
+                            int(os.environ.get("D4PG_FLEET_KEEP", "8")))
         print(json.dumps(artifact))
         return
     if "--sharded-overhead" in sys.argv:

@@ -215,6 +215,16 @@ class ExperimentConfig:
     serve_policy_window_s: float = 0.002
     serve_policy_max_rows: int = 256
     serve_policy_sla_s: float = 1.0
+    # Elastic traffic plane (docs/architecture.md "Elastic traffic
+    # plane"): run the obs-driven autoscaler thread next to the serving/
+    # ingest planes — it polls the obs-registry providers and live-
+    # adjusts the serving batch limits, ingest shard depth, dealer
+    # pacing, and active learner-replica count through their bounded
+    # setters, journaling every decision in a replayable ScalingLedger.
+    # Off = every capacity knob stays at its startup value (the
+    # pre-elastic behaviour, bit for bit).
+    autoscale: bool = False
+    autoscale_interval_s: float = 0.25
     # Weight-broadcast version window (docs/architecture.md "Weight
     # plane"): the server keeps this many recent versions so pullers
     # inside the window receive per-tensor deltas instead of full
@@ -470,6 +480,14 @@ def build_parser() -> argparse.ArgumentParser:
                    default=d.serve_policy_sla_s,
                    help="declared params-freshness SLA: batches served "
                         "from an older snapshot count sla_breaches")
+    _add_bool_flag(p, "autoscale", d.autoscale,
+                   "run the obs-driven autoscaler (elastic/autoscaler): "
+                   "live-adjust serving batch limits, ingest depth, "
+                   "dealer pacing and active replica count from "
+                   "registry signals, every decision ledgered")
+    p.add_argument("--autoscale_interval_s", type=float,
+                   default=d.autoscale_interval_s,
+                   help="autoscaler control-loop period")
     p.add_argument("--weight_window", type=int, default=d.weight_window,
                    help="weight-broadcast delta window: recent versions "
                         "kept server-side so in-window pullers get "
@@ -547,4 +565,5 @@ def parse_args(argv=None) -> ExperimentConfig:
     ns["strict_reference"] = bool(ns["strict_reference"])
     ns["normalize_obs"] = bool(ns["normalize_obs"])
     ns["sample_on_ingest"] = bool(ns["sample_on_ingest"])
+    ns["autoscale"] = bool(ns["autoscale"])
     return ExperimentConfig(**ns)

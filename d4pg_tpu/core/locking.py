@@ -50,6 +50,14 @@ from d4pg_tpu.obs.registry import REGISTRY as _obs_registry
 # The declared hierarchy — the single source of truth shared with the
 # static pass and the architecture doc. Outermost (largest tier) first.
 HIERARCHY: dict[str, int] = {
+    # Elastic control plane: the autoscaler's own state (targets, tick
+    # counter, stop handshake) lives under one condition ABOVE every
+    # data-plane tier. The loop's contract is sense/decide/actuate with
+    # NOTHING held — providers and actuator setters take their owners'
+    # locks at top level — but the tier placement makes even an
+    # accidental hold-across-actuation legal descent rather than a
+    # silent inversion, so the sentinels report it instead of wedging.
+    "elastic": 60,  # Autoscaler._elastic_cond (targets + tick + stop)
     "service": 50,  # ReplayService._lock (heartbeats, pending, env_steps)
     "buffer": 40,   # ReplayService._buffer_lock (all replay-state access)
     # Multi-learner plane (replica -> aggregator -> store): a replica may

@@ -55,7 +55,7 @@ import numpy as np
 from d4pg_tpu.core.locking import TieredCondition, TieredLock
 from d4pg_tpu.distributed.transport import decode_frame, raw_frame_meta_ex
 from d4pg_tpu.obs.containment import contained_crash
-from d4pg_tpu.obs.flight import record_event
+from d4pg_tpu.obs.flight import EVENT_ADMISSION_REJECT, record_event
 from d4pg_tpu.obs.registry import REGISTRY
 from d4pg_tpu.obs.trace import RECORDER as _tracer
 from d4pg_tpu.replay.prioritized import PrioritizedReplayBuffer
@@ -78,13 +78,17 @@ class _IngestShard:
 
     __slots__ = ("idx", "capacity", "shed_at", "cond", "q", "sheds",
                  "shed_rows", "decode_errors", "rows_in", "staged_rows",
-                 "admit_fails")
+                 "admit_fails", "sheds_by_class")
 
     def __init__(self, idx: int, capacity: int, shed_at: int | None):
         self.idx = idx
         self.capacity = capacity
         self.shed_at = shed_at
         self.cond = TieredCondition("shard")
+        # class-attributed shed ledger (elastic admission): class name
+        # -> rows shed; written under ``cond`` with the queue it
+        # describes, like every other shard counter
+        self.sheds_by_class: dict = {}
         # items: (seq, data, codec, actor_id, rows, count, trace); codec
         # None means ``data`` is an already-decoded TransitionBatch, else
         # it is the undecoded wire payload for ``decode_frame(data,
@@ -109,7 +113,19 @@ class _IngestShard:
                 "rows_in": self.rows_in,
                 "staged_rows": self.staged_rows,
                 "admit_fails": self.admit_fails,
+                "capacity": self.capacity,
+                "shed_at": self.shed_at,
+                "sheds_by_class": dict(self.sheds_by_class),
             }
+
+
+def _merge_class_counts(dicts) -> dict:
+    """Sum per-shard ``sheds_by_class`` ledgers into one fleet view."""
+    out: dict = {}
+    for d in dicts:
+        for k, v in d.items():
+            out[k] = out.get(k, 0) + v
+    return out
 
 
 class ReplayService:
@@ -122,6 +138,7 @@ class ReplayService:
         shed_watermark: float | None = None,
         num_ingest_shards: int = 1,
         generation: int = 0,
+        admission=None,
     ):
         """``shed_watermark`` (fraction of ``ingest_capacity``, fleet-plane
         degradation): when an ingest shard's deque stands at or above the
@@ -192,6 +209,17 @@ class ReplayService:
             else max(1, min(ingest_capacity,
                             int(shed_watermark * ingest_capacity))))
         self._shed_at = shed_at
+        # watermark FRACTION retained so set_ingest_depth (the elastic
+        # autoscaler's actuator) can recompute shed_at when it resizes
+        # the shard deques live
+        self._shed_watermark = shed_watermark
+        # Optional elastic.AdmissionPolicy: priority-tagged shedding.
+        # None (default) keeps the flat shed-oldest behavior bit-for-bit;
+        # with a policy the shed victim is the oldest batch of the WORST
+        # queued class, and every shed/reject is class-attributed in
+        # sheds_by_class. Frozen/stateless, so sharing it across shard
+        # conditions adds no lock edge.
+        self._admission = admission
         self.evictions = 0
         self.readmissions = 0
         self._evicted: dict[str, float] = {}
@@ -353,20 +381,45 @@ class ReplayService:
         shed_tids: list[int] = []
         shed_batches = 0
         admitted = False
+        rejected_cls: str | None = None
+        pol = self._admission
         with s.cond:
             if s.shed_at is not None:
-                # shed-oldest admission: bounded work, never blocks. The
-                # counter and the deque mutate under the same lock — the
-                # consistent-snapshot contract of ingest_stats().
+                # shed admission: bounded work, never blocks. The counter
+                # and the deque mutate under the same lock — the
+                # consistent-snapshot contract of ingest_stats(). Without
+                # a policy this is flat shed-oldest; with one the victim
+                # is the oldest batch of the WORST queued class, and an
+                # incoming batch that ranks below everything queued is
+                # itself rejected (class-attributed) rather than evicting
+                # more-protected work.
+                inc_cls = (None if pol is None
+                           else pol.classify_actor(actor_id))
+                admitted = True
                 while len(s.q) >= s.shed_at:
-                    old = s.q.popleft()
+                    if pol is None:
+                        victim = 0
+                    else:
+                        classes = [pol.classify_actor(it[3]) for it in s.q]
+                        victim = pol.shed_victim(classes, inc_cls)
+                        if victim is None:
+                            admitted = False
+                            rejected_cls = pol.class_name(inc_cls)
+                            s.sheds_by_class[rejected_cls] = (
+                                s.sheds_by_class.get(rejected_cls, 0) + rows)
+                            break
+                    old = s.q[victim]
+                    del s.q[victim]
                     s.sheds += 1
                     s.shed_rows += old[4]
+                    if pol is not None:
+                        name = pol.class_name(classes[victim])
+                        s.sheds_by_class[name] = (
+                            s.sheds_by_class.get(name, 0) + old[4])
                     shed_seqs.append(old[0])
                     if old[6] is not None:
                         shed_tids.append(old[6][0])
                     shed_batches += 1
-                admitted = True
             elif len(s.q) >= s.capacity:
                 if block:
                     deadline = (None if timeout is None
@@ -399,6 +452,13 @@ class ReplayService:
             record_event("admit", shard=s.idx, actor=actor_id, rows=rows)
             REGISTRY.counter("ingest.rows_admitted").inc(rows)
         else:
+            if rejected_cls is not None:
+                # class-policy rejection: a load verdict attributed to the
+                # incoming batch's priority class, distinct from the
+                # timeout path's admit_fail
+                record_event(EVENT_ADMISSION_REJECT, plane="ingest",
+                             shard=s.idx, actor=actor_id, cls=rejected_cls,
+                             rows=rows)
             record_event("admit_fail", shard=s.idx, actor=actor_id,
                          rows=rows)
             if trace is not None:
@@ -649,6 +709,25 @@ class ReplayService:
         with self._lock:
             self._env_steps = int(n)
 
+    def set_ingest_depth(self, capacity: int) -> None:
+        """Live-resize the per-shard admission deques (elastic actuator).
+
+        The shed watermark (when configured) is recomputed at the SAME
+        fraction of the new capacity, so a deepened shard genuinely
+        absorbs a flash crowd instead of shedding at the old bound.
+        Each shard condition is taken and released in turn at top level
+        (shard tier, nothing else held) — no new lock edges, and a
+        snapshot taken mid-resize just reports the conservative
+        (minimum) bound via ``ingest_stats()``."""
+        cap = max(1, int(capacity))
+        for s in self._shards:
+            with s.cond:
+                s.capacity = cap
+                if s.shed_at is not None and self._shed_watermark is not None:
+                    s.shed_at = max(
+                        1, min(cap, int(self._shed_watermark * cap)))
+                s.cond.notify_all()  # blocked adds may now fit
+
     def __len__(self) -> int:
         with self._buffer_lock:
             return len(self.buffer)
@@ -733,6 +812,15 @@ class ReplayService:
             "shed_rows": sum(p["shed_rows"] for p in per_shard),
             "decode_errors": sum(p["decode_errors"] for p in per_shard),
             "admit_fails": sum(p["admit_fails"] for p in per_shard),
+            # class-attributed shed ledger (elastic admission): covers
+            # both evicted-queued rows and policy-rejected incoming rows,
+            # so it can exceed shed_rows when incoming work is bounced
+            "sheds_by_class": _merge_class_counts(
+                p["sheds_by_class"] for p in per_shard),
+            # live per-shard deque bound — the elastic autoscaler's
+            # set_ingest_depth actuator target (min across shards so a
+            # mid-resize snapshot reports the conservative bound)
+            "ingest_capacity": min(p["capacity"] for p in per_shard),
             "num_ingest_shards": self.num_ingest_shards,
             "commit_backlog": commit_backlog,
             "order_breaks": order_breaks,

@@ -1,0 +1,36 @@
+"""Elastic traffic plane: seeded load model, obs-driven autoscaler,
+SLO admission control (docs/architecture.md "Elastic traffic plane").
+
+Three cooperating pieces close the loop the fleet plane left open:
+
+- ``traffic``   — a seeded offered-load model (diurnal curve, flash
+                  crowds, per-actor heavy-tailed Pareto rates); every
+                  trace is bit-for-bit replayable from its seed, the
+                  same contract as the PR-3 chaos scripts.
+- ``admission`` — priority classes over actor/lane identity plus the
+                  per-class shed/budget policy ``ReplayService`` and
+                  ``PolicyInferenceServer`` enforce at admission.
+- ``autoscaler``/``ledger`` — the control loop (sense obs-registry
+                  providers, decide with hysteresis, actuate live
+                  knobs) and the deterministic decision ledger that
+                  makes every run's decision stream auditable and
+                  replayable.
+"""
+
+from d4pg_tpu.elastic.admission import AdmissionPolicy
+from d4pg_tpu.elastic.autoscaler import (
+    Autoscaler, AutoscalerConfig, ControlPolicy, extract_signals,
+)
+from d4pg_tpu.elastic.ledger import ScalingLedger
+from d4pg_tpu.elastic.traffic import TrafficConfig, TrafficModel
+
+__all__ = [
+    "AdmissionPolicy",
+    "Autoscaler",
+    "AutoscalerConfig",
+    "ControlPolicy",
+    "ScalingLedger",
+    "TrafficConfig",
+    "TrafficModel",
+    "extract_signals",
+]

@@ -1,0 +1,97 @@
+"""Priority classes + the per-class admission policy.
+
+The fleet plane's original overload behavior was FLAT: a shard at its
+shed watermark evicts the oldest queued batch regardless of whose it
+is, and the serving queue is unbounded. Under a flash crowd that means
+latency-critical work is exactly as likely to be shed as bulk backfill.
+This module makes admission class-aware:
+
+- every producer identity (actor id on the ingest plane, lane id on
+  the serving plane) maps to a PRIORITY CLASS — class 0 is the most
+  protected. Classification is derived from identity server-side, so a
+  client cannot self-promote by asserting a priority byte on the wire
+  (and no wire format changes at all);
+- under pressure the LOWEST-priority work is shed first (oldest within
+  the class), and an incoming low-class item is itself the victim when
+  everything queued outranks it;
+- every shed/reject is ATTRIBUTED to its class in the owning
+  component's ledger (``sheds_by_class`` in ``ingest_stats()``,
+  ``admission_rejects_by_class`` in ``serving_stats()``), so an SLO
+  report can show who paid for the overload.
+
+The policy object is frozen and stateless — safe to share across every
+shard condition and the serving condition without adding a single lock
+edge.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import zlib
+
+_TRAILING_INT = re.compile(r"(\d+)\s*$")
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionPolicy:
+    """Class table + per-class queue budgets.
+
+    ``classes`` are ordered most-protected first. ``depth_fracs`` give
+    each class's share of a queue-depth bound: class c is admitted only
+    while the queue stands below ``frac[c] * bound`` — so when the
+    queue passes the bulk budget, bulk work bounces while protected
+    work still lands, which is precisely a strict-priority admission
+    curve without any queue reordering."""
+
+    classes: tuple[str, ...] = ("rt", "bulk")
+    depth_fracs: tuple[float, ...] = (1.0, 0.5)
+
+    def __post_init__(self):
+        if len(self.classes) != len(self.depth_fracs) or not self.classes:
+            raise ValueError("classes and depth_fracs must align, non-empty")
+        if any(not (0.0 < f <= 1.0) for f in self.depth_fracs):
+            raise ValueError("depth_fracs must be in (0, 1]")
+
+    @property
+    def n_classes(self) -> int:
+        return len(self.classes)
+
+    def classify_index(self, index: int) -> int:
+        """Lane/actor INDEX -> class, interleaved (index % n_classes) so
+        every class is populated at any fleet size."""
+        return int(index) % self.n_classes
+
+    def classify_actor(self, actor_id: str) -> int:
+        """Actor-id string -> class: a trailing integer (the fleet's
+        ``actor-<i>`` / ``proc-<i>`` convention) classifies by index;
+        anything else falls back to a crc32 of the id (NOT ``hash()``,
+        which is salted per process and would reclassify actors across
+        restarts)."""
+        m = _TRAILING_INT.search(actor_id)
+        if m is not None:
+            return self.classify_index(int(m.group(1)))
+        return zlib.crc32(actor_id.encode()) % self.n_classes
+
+    def class_name(self, cls: int) -> str:
+        return self.classes[min(max(cls, 0), self.n_classes - 1)]
+
+    def depth_for(self, cls: int, depth_bound: int) -> int:
+        """Queue-depth budget for ``cls`` under ``depth_bound``."""
+        frac = self.depth_fracs[min(max(cls, 0), self.n_classes - 1)]
+        return max(1, int(frac * depth_bound))
+
+    def shed_victim(self, queued_classes: list[int],
+                    incoming_cls: int) -> int | None:
+        """Pick the shed victim among ``queued_classes`` (queue order,
+        oldest first) and the incoming item. Returns the QUEUE INDEX of
+        the victim, or None when the incoming item itself is the
+        lowest-priority work (caller rejects it instead of evicting
+        better-class work — no priority inversion)."""
+        if not queued_classes:
+            return None
+        worst = max(queued_classes)
+        if incoming_cls > worst:
+            return None
+        # oldest item of the worst class present
+        return queued_classes.index(worst)

@@ -1,0 +1,95 @@
+"""Scaling-decision ledger: every observation -> decision -> actuation
+tuple the autoscaler takes, recorded in order.
+
+The autoscaler changes live capacity knobs on a running fleet — the
+one category of mutation that is invisible in a post-hoc artifact
+unless it is journaled. The ledger is that journal, with two jobs:
+
+- **audit**: each record carries the signals the decision saw, the
+  decisions taken, the targets after, and which actuators actually
+  fired (plus any actuator errors, degrade-and-count style);
+- **replayability**: the decision core (``autoscaler.ControlPolicy``)
+  is a pure function of (config, signal stream, control state), so
+  re-running it over the recorded signals MUST reproduce the recorded
+  decision stream bit for bit. ``digest()`` canonicalizes exactly the
+  replay-covered fields — wall-clock timestamps ride the records for
+  humans but stay OUT of the digest, which is what lets two runs of
+  the same seed pin stream equality with one string compare.
+
+Locking: one plain terminal ``_mu`` (the obs-plane discipline — no
+path holding it acquires anything else), so the ledger adds zero lock
+edges no matter which thread appends.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+
+
+def canonical_record(rec: dict) -> dict:
+    """The replay-covered projection of a record: tick, sensed signals,
+    decisions, post-decision targets. Deterministic across runs of the
+    same seed; excludes wall time and actuation outcomes (an actuator
+    error is an environment fact, not a decision fact)."""
+    return {
+        "tick": rec["tick"],
+        "signals": dict(sorted(rec["signals"].items())),
+        "decisions": dict(sorted(rec["decisions"].items())),
+        "targets": dict(sorted(rec["targets"].items())),
+    }
+
+
+class ScalingLedger:
+    """Append-only, bounded decision journal (oldest dropped past
+    ``capacity`` with the drop counted — a week-long run must not grow
+    an unbounded list; the digest covers what is retained plus the
+    count of what is not)."""
+
+    def __init__(self, capacity: int = 8192):
+        self._mu = threading.Lock()
+        self._records: list[dict] = []
+        self._dropped = 0
+        self._capacity = max(1, int(capacity))
+
+    def append(self, rec: dict) -> None:
+        with self._mu:
+            self._records.append(rec)
+            if len(self._records) > self._capacity:
+                self._records.pop(0)
+                self._dropped += 1
+
+    def records(self) -> list[dict]:
+        with self._mu:
+            return list(self._records)
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._records)
+
+    @property
+    def dropped(self) -> int:
+        with self._mu:
+            return self._dropped
+
+    def digest(self) -> str:
+        """sha256 over the canonical (replay-covered) stream — the
+        decision-stream-equality oracle compares two of these."""
+        with self._mu:
+            recs = list(self._records)
+            dropped = self._dropped
+        doc = {"dropped": dropped,
+               "records": [canonical_record(r) for r in recs]}
+        blob = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+        return hashlib.sha256(blob.encode()).hexdigest()
+
+    def to_jsonable(self, tail: int | None = None) -> dict:
+        """Artifact form: digest + (optionally tail-truncated) records."""
+        with self._mu:
+            recs = list(self._records)
+            dropped = self._dropped
+        if tail is not None:
+            recs = recs[-tail:]
+        return {"digest": self.digest(), "dropped": dropped,
+                "n_records": len(self), "records": recs}

@@ -1,0 +1,177 @@
+"""Seeded offered-load model for the fleet plane.
+
+The harness's flat ``rows_per_sec`` measures the planes at a KNOWN
+constant demand; production traffic is nothing like that — it breathes
+on a diurnal cycle, spikes in flash crowds, and spreads across actors
+on a heavy tail (a few hot lanes carry most of the load). This module
+is that load, as a pure function: ``rate(actor, t)`` is fully
+determined by ``TrafficConfig`` (seed included), so two models built
+from the same config emit bit-for-bit identical traces — the same
+replayability contract as the chaos scripts (``fleet/chaos.py``), and
+the property the A/B drill leans on to hold OFFERED load equal across
+arms while the autoscaler varies everything else.
+
+Determinism discipline (the chaos-script rules):
+
+- every stochastic component draws from its OWN ``SeedSequence``
+  branch (disjoint ``spawn_key`` tags), so adding one component never
+  shifts another's stream;
+- the flash-crowd event stream draws a FIXED number of variates per
+  event (gap, duration, amplitude), keeping event k's draws at stream
+  offset 3k regardless of parameters;
+- the whole schedule is materialized eagerly in ``__init__`` up to
+  ``horizon_s`` — after construction the model is IMMUTABLE, so lanes
+  on different threads read it lock-free (no lock edges, nothing for
+  the lockgraph to even see).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+# SeedSequence spawn-key tags (disjoint from the chaos planes' 0x5E11 /
+# 0xD4B0 / 0xD4E4 / 0xD4E5 tags): diurnal phase, flash-crowd event
+# stream, per-actor Pareto weights.
+_TAG_DIURNAL = 0xE7A0
+_TAG_FLASH = 0xE7A1
+_TAG_PARETO = 0xE7A2
+
+# Fixed draw count per flash event (gap, duration, amplitude) — the
+# stream-offset stability rule.
+_DRAWS_PER_FLASH = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficConfig:
+    """Everything the offered-load surface depends on. Frozen: the
+    config IS the trace identity (hash it, log it, replay it)."""
+
+    seed: int = 0
+    n_actors: int = 4
+    # fleet-mean per-lane rate at multiplier 1.0 (rows/s); the actual
+    # lane rate is base * pareto_weight[actor] * diurnal(t) * flash(t)
+    base_rows_per_sec: float = 256.0
+    # diurnal component: 1 + amp * sin(2*pi*(t/period + phase)), phase
+    # seeded per-run. amp=0 disables. Period is model seconds — scaled
+    # way down from 86400 so a bench run crosses full cycles.
+    diurnal_amp: float = 0.3
+    diurnal_period_s: float = 60.0
+    # flash crowds: either a SCRIPTED schedule of (start_s, duration_s,
+    # amplitude) triples (the A/B drill pins its crowd this way), or —
+    # when None — a seeded renewal process: exponential gaps at
+    # ``flash_rate_per_s``, uniform durations/amplitudes in the given
+    # ranges, materialized out to ``horizon_s``.
+    flash_schedule: tuple[tuple[float, float, float], ...] | None = None
+    flash_rate_per_s: float = 0.02
+    flash_duration_s: tuple[float, float] = (2.0, 6.0)
+    flash_amp: tuple[float, float] = (4.0, 10.0)
+    # per-actor heavy tail: Pareto(alpha) weights normalized to mean
+    # 1.0 across the fleet (so fleet offered load stays
+    # n_actors * base regardless of the tail draw). alpha <= 2 has
+    # infinite variance — 1.5 is the classic "few hot lanes" shape.
+    pareto_alpha: float = 1.5
+    # floor under the composed rate so a deep diurnal trough can never
+    # stall a lane entirely (a zero rate would divide the tick period).
+    min_rows_per_sec: float = 1.0
+    # schedule horizon: flash events are materialized to here; past it
+    # the flash multiplier is 1.0 (queries stay valid, just calm).
+    horizon_s: float = 3600.0
+
+
+class TrafficModel:
+    """Immutable seeded offered-load surface; see module docstring."""
+
+    def __init__(self, cfg: TrafficConfig):
+        self.cfg = cfg
+        # diurnal phase: one uniform draw on its own branch
+        d_rng = np.random.default_rng(
+            np.random.SeedSequence(cfg.seed, spawn_key=(_TAG_DIURNAL, 0)))
+        self._diurnal_phase = float(d_rng.random())
+        # per-actor Pareto weights, one branch per actor (adding lanes
+        # extends the weight vector without disturbing existing lanes'
+        # draws), normalized to mean 1.0
+        raw = np.empty(max(1, cfg.n_actors), np.float64)
+        for i in range(raw.shape[0]):
+            rng = np.random.default_rng(
+                np.random.SeedSequence(cfg.seed, spawn_key=(_TAG_PARETO, i)))
+            u = rng.random()
+            raw[i] = (1.0 - u) ** (-1.0 / cfg.pareto_alpha)
+        self._weights = raw / raw.mean()
+        # flash-crowd schedule: scripted verbatim, or the seeded renewal
+        # stream at fixed draws per event
+        if cfg.flash_schedule is not None:
+            self._flash = [(float(s), float(d), float(a))
+                           for s, d, a in cfg.flash_schedule]
+        else:
+            f_rng = np.random.default_rng(
+                np.random.SeedSequence(cfg.seed, spawn_key=(_TAG_FLASH, 0)))
+            events = []
+            t = 0.0
+            rate = max(1e-9, cfg.flash_rate_per_s)
+            while True:
+                gap = f_rng.exponential(1.0 / rate)
+                dur = f_rng.uniform(*cfg.flash_duration_s)
+                amp = f_rng.uniform(*cfg.flash_amp)
+                t += gap
+                if t >= cfg.horizon_s:
+                    break
+                events.append((t, dur, amp))
+            self._flash = events
+
+    # -- components ---------------------------------------------------------
+    def pareto_weight(self, actor: int) -> float:
+        return float(self._weights[actor % self._weights.shape[0]])
+
+    def diurnal(self, t: float) -> float:
+        c = self.cfg
+        if c.diurnal_amp == 0.0:
+            return 1.0
+        m = 1.0 + c.diurnal_amp * math.sin(
+            2.0 * math.pi * (t / c.diurnal_period_s + self._diurnal_phase))
+        return max(0.0, m)
+
+    def flash(self, t: float) -> float:
+        """Multiplier from flash crowds active at ``t`` (overlapping
+        crowds take the max, not the product — two simultaneous events
+        are one bigger crowd, not a multiplicative explosion)."""
+        m = 1.0
+        for start, dur, amp in self._flash:
+            if start <= t < start + dur:
+                m = max(m, amp)
+        return m
+
+    def flash_events(self) -> list[tuple[float, float, float]]:
+        return list(self._flash)
+
+    # -- the surface --------------------------------------------------------
+    def rate(self, actor: int, t: float) -> float:
+        """Offered load for ``actor`` at model time ``t`` (rows/s)."""
+        c = self.cfg
+        r = (c.base_rows_per_sec * self.pareto_weight(actor)
+             * self.diurnal(t) * self.flash(t))
+        return max(c.min_rows_per_sec, r)
+
+    def rate_fn(self, actor: int):
+        """Per-lane closure for ``ThrottledSender(rate_fn=...)``: the
+        lane advances its own model clock tick by tick, so the offered
+        schedule is a pure recurrence — independent of wall-clock
+        jitter and therefore identical across runs."""
+        return lambda t: self.rate(actor, t)
+
+    def trace(self, actor: int, horizon_s: float, dt: float) -> np.ndarray:
+        """The offered-load curve sampled on a fixed grid — the
+        determinism oracle's artifact (two models, same config, equal
+        arrays bit for bit) and the bench block's offered curve."""
+        ts = np.arange(0.0, horizon_s, dt, dtype=np.float64)
+        return np.array([self.rate(actor, float(t)) for t in ts],
+                        np.float64)
+
+    def fleet_trace(self, horizon_s: float, dt: float) -> np.ndarray:
+        """Summed offered load across every lane on the same grid."""
+        total = np.zeros(int(math.ceil(horizon_s / dt)), np.float64)
+        for a in range(self.cfg.n_actors):
+            total += self.trace(a, horizon_s, dt)[: total.shape[0]]
+        return total

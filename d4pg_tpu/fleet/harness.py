@@ -33,6 +33,7 @@ import numpy as np
 from d4pg_tpu.core import locking
 from d4pg_tpu.distributed.replay_service import ReplayService
 from d4pg_tpu.distributed.transport import TransitionReceiver
+from d4pg_tpu.elastic.traffic import TrafficConfig, TrafficModel
 from d4pg_tpu.fleet.chaos import ChaosConfig, ChaosPolicy, StallGate
 from d4pg_tpu.fleet.sender import ThrottledSender, synthetic_block
 from d4pg_tpu.obs import flight as obs_flight
@@ -116,6 +117,13 @@ class FleetConfig:
     # 'actor' mode knobs: the env each real actor runs and its pool width
     actor_env: str = "point"
     actor_num_envs: int = 2
+    # Elastic traffic plane (elastic/traffic.py): when set, thread-mode
+    # lanes pace themselves off the seeded TrafficModel (diurnal curve +
+    # flash crowds + heavy-tailed per-actor rates) instead of the flat
+    # ``rows_per_sec`` — the offered-load trace replays bit-for-bit from
+    # ``traffic.seed``. ``rows_per_sec`` still feeds the demand estimate
+    # shown in reports (the traffic model's base rate should match it).
+    traffic: TrafficConfig | None = None
 
     def __post_init__(self):
         if self.mode not in ("thread", "process", "actor"):
@@ -363,6 +371,8 @@ class FleetHarness:
         template = synthetic_block(cfg.block_rows, cfg.obs_dim, cfg.act_dim,
                                    seed=cfg.template_seed)
         stop = threading.Event()
+        traffic_model = (TrafficModel(cfg.traffic)
+                         if cfg.traffic is not None else None)
         lanes = [
             ThrottledSender(
                 i, f"fleet-{i}", "127.0.0.1", port, template,
@@ -376,6 +386,8 @@ class FleetHarness:
                 expect_generation=svc_chaos,
                 reconnect_jitter_s=(cfg.reconnect_jitter_s if svc_chaos
                                     else 0.0),
+                rate_fn=(traffic_model.rate_fn(i)
+                         if traffic_model is not None else None),
             )
             for i in range(cfg.n_actors)
         ]

@@ -86,6 +86,7 @@ class ThrottledSender:
         trace_sample: float = 0.0,
         expect_generation: bool = False,
         reconnect_jitter_s: float = 0.0,
+        rate_fn=None,
     ):
         self.actor_index = actor_index
         self.actor_id = actor_id
@@ -94,6 +95,14 @@ class ThrottledSender:
         self.chaos = chaos
         self._block_rows = int(np.asarray(template.obs).shape[0])
         self._period = self._block_rows / float(rows_per_sec)
+        # Elastic traffic model (elastic/traffic.py): rate_fn maps MODEL
+        # time (seconds of offered load already emitted, a pure
+        # recurrence over the lane's own tick periods) to rows/sec. Model
+        # time — not the wall clock — keeps the offered-load trace a
+        # deterministic function of the seed: scheduler jitter changes
+        # when blocks go out, never how many.
+        self._rate_fn = rate_fn
+        self._model_t = 0.0
         self._send_timeout = send_timeout
         self._max_retries = max_retries
         self._secret = secret
@@ -189,6 +198,13 @@ class ThrottledSender:
                         sender = self._reconnect()
                     if sender is not None:
                         self._send_block(sender)
+                if self._rate_fn is not None:
+                    # traffic-model pacing: recompute the tick period from
+                    # the modeled rate at the lane's model clock, then
+                    # advance the clock by that period
+                    rate = max(1e-6, float(self._rate_fn(self._model_t)))
+                    self._period = self._block_rows / rate
+                    self._model_t += self._period
                 next_t += self._period
                 wait = next_t - time.monotonic()
                 if wait > 0:
@@ -245,6 +261,7 @@ class ThrottledSender:
             "storm_jitter_s": list(self.storm_jitter_s),
             "recovery_s": list(self.recovery_s),
             "latencies_ms": list(self.latencies_ms),
+            "model_t": self._model_t,
             "chaos_log": [tuple(ev) for ev in self.chaos.log],
         }
 

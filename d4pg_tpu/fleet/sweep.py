@@ -546,6 +546,41 @@ def run_serving(
             "batching": pair, "chaos": chaos_row, "seed": int(seed)}
 
 
+def run_elastic(seed: int = 0, **overrides) -> dict:
+    """The bench_fleet elastic block (``fleet/elastic_chaos.py``): the
+    flash-crowd A/B drill — identical seeded offered load (the traffic
+    model's schedule is a pure recurrence over each lane's model clock)
+    through a static arm and an autoscaler arm — plus the offered-load
+    determinism probe (two models from the same config must emit the
+    bit-identical fleet curve). The drill's ``ab_gate`` must pass in
+    every committed artifact: strictly fewer serving SLO breaches AND
+    strictly fewer ingest shed rows in the autoscaler arm, with the
+    scaling ledger replaying bit-identically from its recorded
+    signals."""
+    import numpy as np
+
+    from d4pg_tpu.elastic.traffic import TrafficModel
+    from d4pg_tpu.fleet.elastic_chaos import (
+        ElasticChaosConfig,
+        run_elastic_chaos,
+    )
+
+    drill = run_elastic_chaos(seed=int(seed), **overrides)
+    cfg = ElasticChaosConfig(seed=int(seed))
+    tcfg = cfg.serving_traffic()
+    dt = cfg.model_horizon_s / 48.0
+    offered = TrafficModel(tcfg).fleet_trace(cfg.model_horizon_s, dt)
+    replayed = TrafficModel(tcfg).fleet_trace(cfg.model_horizon_s, dt)
+    return {
+        "metric": "fleet_elastic",
+        "schema": 1,
+        "offered_rows_per_s": [round(float(x), 2) for x in offered],
+        "offered_deterministic": bool(np.array_equal(offered, replayed)),
+        "drill": drill,
+        "seed": int(seed),
+    }
+
+
 def _lock_wait_ms(row: dict) -> float | None:
     """Total contended-acquisition wait across every tiered lock."""
     locks = row.get("locks")
@@ -613,6 +648,10 @@ def main(argv=None):
                          "these lane counts, a batched-vs-unbatched pair "
                          "and one server-kill chaos row "
                          "(fleet/serving_chaos.py)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run the elastic block instead: the flash-crowd "
+                         "autoscaler-on/off A/B drill at equal seeded "
+                         "offered load (fleet/elastic_chaos.py)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--no_chaos", action="store_true",
                     help="clean-plane control run (all fault probs 0)")
@@ -621,7 +660,9 @@ def main(argv=None):
     ns = ap.parse_args(argv)
     chaos = (ChaosConfig(seed=ns.seed) if ns.no_chaos
              else default_chaos(ns.seed))
-    if ns.sampler:
+    if ns.elastic:
+        artifact = run_elastic(seed=ns.seed)
+    elif ns.sampler:
         artifact = run_sampler(
             n_actors=max(ns.ns), duration_s=ns.seconds, seed=ns.seed,
             **({"learner_kills": 0, "stale_frames": 0}

@@ -86,6 +86,7 @@ _INIT_FNS = {"__init__", "__post_init__", "__new__", "__init_subclass__"}
 # an edge out of a leaf into an equal-or-higher tier is the merge-wedge
 # shape even when no full cycle (yet) closes it.
 _DEFAULT_TIERS = {
+    "_elastic_cond": "elastic",
     "_lock": "service",
     "_buffer_lock": "buffer",
     "_commit_cond": "commit",
@@ -108,9 +109,10 @@ _DEFAULT_TIERS = {
 # the lint package is stdlib-only by contract (``d4pg_tpu.core``'s
 # package __init__ pulls jax). tests/test_locking.py pins the two
 # tables equal, so they cannot drift.
-_TIER_VALUES = {"service": 50, "buffer": 40, "replica": 36, "agg": 34,
-                "commit": 30, "wrelay": 28, "wserve": 26, "pserve": 25,
-                "wstore": 24, "shard": 20, "sampler": 15, "ring": 10}
+_TIER_VALUES = {"elastic": 60, "service": 50, "buffer": 40, "replica": 36,
+                "agg": 34, "commit": 30, "wrelay": 28, "wserve": 26,
+                "pserve": 25, "wstore": 24, "shard": 20, "sampler": 15,
+                "ring": 10}
 
 
 def _tier_values() -> dict[str, int]:

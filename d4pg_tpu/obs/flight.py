@@ -131,6 +131,16 @@ def prune_artifacts(directory: str, prefix: str, keep: int) -> list[str]:
     return doomed
 
 
+# Elastic-plane event kinds (d4pg_tpu/elastic): the autoscaler records
+# one event per applied scaling decision and the admission-controlled
+# services record one per class-attributed rejection. Declared here as
+# constants so the recorder, the emitters, and the postmortem readers
+# agree on the vocabulary (free-form kinds stay legal — these are the
+# ones the elastic drill's assertions grep for).
+EVENT_SCALE_UP = "scale_up"
+EVENT_SCALE_DOWN = "scale_down"
+EVENT_ADMISSION_REJECT = "admission_reject"
+
 # THE process-wide recorder: the receiver-side planes (replay service,
 # locking sentinels, transport retries) publish here, the fleet harness
 # dumps it.

@@ -348,6 +348,16 @@ class SampleDealer:
         ``ReplayService.attach_dealer`` wires their demand kicks)."""
         return tuple(self._rings)
 
+    def set_pacing(self, max_deals_per_tick: int) -> None:
+        """Live-adjust the per-tick deal budget (elastic actuator).
+
+        Taken under the sampler lock so a mid-tick deal loop reads one
+        coherent value; the budget bounds how far each commit's critical
+        section is extended by drawing, so the autoscaler halves it when
+        the ingest plane is the bottleneck and restores it when idle."""
+        with self._sampler_lock:
+            self.max_deals_per_tick = max(1, int(max_deals_per_tick))
+
     # -- commit-thread side (buffer lock held) ------------------------------
     def ingest_and_deal(self, inserts, buffer) -> list:
         """Mirror a commit's inserts, settle pending write-backs, then

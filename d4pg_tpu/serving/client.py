@@ -362,8 +362,8 @@ class RemotePolicyClient:
         self.stats_lock = threading.Lock()
         self._stats = {
             "requests": 0, "served": 0, "timeouts": 0, "torn_rejected": 0,
-            "wire_errors": 0, "no_params": 0, "fallbacks": 0,
-            "warmup_fallbacks": 0, "reconnects": 0,
+            "wire_errors": 0, "no_params": 0, "overload_rejected": 0,
+            "fallbacks": 0, "warmup_fallbacks": 0, "reconnects": 0,
         }
 
     @property
@@ -479,7 +479,13 @@ class RemotePolicyClient:
             self._drop_conn()
             return None
         if rsp["status"] != protocol.STATUS_OK:
-            self._count("no_params")
+            # overload = the server's admission budget said no (elastic
+            # plane) — same degradation rung as no-params (fall back to
+            # cached params, then warmup), separate counter so a load
+            # verdict never masquerades as a freshness gap
+            self._count("overload_rejected"
+                        if rsp["status"] == protocol.STATUS_OVERLOAD
+                        else "no_params")
             return None
         self._count("served")
         self._generation = rsp["generation"]

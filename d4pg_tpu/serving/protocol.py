@@ -59,6 +59,13 @@ class ProtocolError(RuntimeError):
 STATUS_OK = 0
 STATUS_NO_PARAMS = 1
 STATUS_BAD_REQUEST = 2
+# SLO admission control (d4pg_tpu/elastic): the server's per-class
+# admission budget rejected this request — a load verdict, not an
+# error. Clients degrade down their ladder (cached params, then
+# warmup) exactly as for no-params; the status is separate so both
+# sides can attribute the rejection. Payload-free like the other
+# non-OK statuses: no frame-shape or flag-bit change.
+STATUS_OVERLOAD = 3
 
 
 class TornFrameError(ProtocolError):

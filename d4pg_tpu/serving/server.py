@@ -51,7 +51,7 @@ from d4pg_tpu.core.locking import TieredCondition
 from d4pg_tpu.learner.state import D4PGConfig
 from d4pg_tpu.learner.update import act_deterministic
 from d4pg_tpu.obs.containment import contained_crash
-from d4pg_tpu.obs.flight import record_event
+from d4pg_tpu.obs.flight import EVENT_ADMISSION_REJECT, record_event
 from d4pg_tpu.obs.registry import REGISTRY, percentile_summary
 from d4pg_tpu.obs.trace import RECORDER
 from d4pg_tpu.distributed.transport import (
@@ -116,6 +116,9 @@ class PolicyInferenceServer(ConnRegistry):
         refresh_interval_s: float = 0.02,
         device: str = "cpu",
         chaos: ServingChaos | None = None,
+        admission=None,
+        admission_depth: int = 64,
+        sla_latency_ms: float | None = None,
     ):
         super().__init__()
         self.config = config
@@ -125,6 +128,20 @@ class PolicyInferenceServer(ConnRegistry):
         self.max_batch_rows = int(max_batch_rows)
         self.sla_staleness_s = float(sla_staleness_s)
         self.refresh_interval_s = float(refresh_interval_s)
+        # SLO admission control (docs/architecture.md "Elastic traffic
+        # plane"): with an ``elastic.AdmissionPolicy`` attached, each
+        # request's lane id (the top 12 bits of req_id — identity the
+        # client cannot forge upward, no wire change) classifies it,
+        # and class c is admitted only while the pending queue stands
+        # below its share of ``admission_depth``. Rejections answer
+        # STATUS_OVERLOAD immediately and are attributed per class.
+        # None (default) keeps the unbounded legacy queue bit-for-bit.
+        self._admission = admission
+        self.admission_depth = int(admission_depth)
+        # Optional queueing-latency SLO: a served response whose
+        # enqueue->write latency exceeds this counts a latency breach
+        # (the staleness SLA above is freshness; this is promptness).
+        self.sla_latency_ms = sla_latency_ms
         self.chaos = chaos
         self._obs_dim = int(config.obs_dim)
         self._act_device = resolve_act_device(device)
@@ -142,8 +159,12 @@ class PolicyInferenceServer(ConnRegistry):
             "requests": 0, "responses_ok": 0, "batches": 0, "rows": 0,
             "padded_rows": 0, "no_params": 0, "bad_requests": 0,
             "write_errors": 0, "adoptions": 0, "fenced_rejected": 0,
-            "sla_breaches": 0,
+            "sla_breaches": 0, "admission_rejects": 0,
+            "latency_breaches": 0,
         }
+        # per-class admission attribution (class name -> rejected
+        # requests), written under the serving condition like stats
+        self.admission_rejects_by_class: dict[str, int] = {}
         # ---- wiring ----
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -213,6 +234,27 @@ class PolicyInferenceServer(ConnRegistry):
                 return None
             return time.monotonic() - self._published_ts
 
+    # -- live capacity knobs (elastic actuators) ----------------------------
+    def set_batch_limits(self, window_s: float | None = None,
+                         max_rows: int | None = None) -> None:
+        """Live-adjust the continuous-batching knobs. The batch loop
+        reads both on every iteration under the serving condition, so a
+        set takes effect at the next window — no restart, no drain. The
+        autoscaler calls this with nothing held (top-level pserve
+        acquisition: no new lock edges)."""
+        with self._pserve_cond:
+            if window_s is not None:
+                self.batch_window_s = float(window_s)
+            if max_rows is not None:
+                self.max_batch_rows = max(1, int(max_rows))
+            self._pserve_cond.notify()
+
+    def set_admission_depth(self, depth: int) -> None:
+        """Live-adjust the admission queue-depth bound the per-class
+        budgets are computed against."""
+        with self._pserve_cond:
+            self.admission_depth = max(1, int(depth))
+
     # -- connections --------------------------------------------------------
     def _accept(self) -> None:
         try:
@@ -277,7 +319,11 @@ class PolicyInferenceServer(ConnRegistry):
     def _admit_request(self, conn: socket.socket, req: dict) -> None:
         """Admit one decoded request into the pending queue, opening its
         trace span; custody of the span rides the queue entry from here
-        (the batcher's response path commits or sheds it)."""
+        (the batcher's response path commits or sheds it). With an
+        admission policy attached, the request first passes its class's
+        queue-depth budget — a rejection answers STATUS_OVERLOAD from
+        this (reader) thread and the span terminal-sheds, so the
+        zero-orphan invariant covers rejected work too."""
         now = time.monotonic()
         tid = None
         if req["trace"] is not None:
@@ -285,16 +331,42 @@ class PolicyInferenceServer(ConnRegistry):
             RECORDER.begin(tid, birth)
             RECORDER.record_span(tid, "admission", now)
         try:
+            rejected_cls = None
             with self._pserve_cond:
                 self.stats["requests"] += 1
-                self._pending.append((conn, req, now))
-                self._pserve_cond.notify()
+                if self._admission is not None:
+                    cls = self._admission.classify_index(
+                        (req["req_id"] >> 20) & 0xFFF)
+                    budget = self._admission.depth_for(
+                        cls, self.admission_depth)
+                    if len(self._pending) >= budget:
+                        name = self._admission.class_name(cls)
+                        self.stats["admission_rejects"] += 1
+                        self.admission_rejects_by_class[name] = \
+                            self.admission_rejects_by_class.get(name, 0) + 1
+                        rejected_cls = name
+                if rejected_cls is None:
+                    self._pending.append((conn, req, now))
+                    self._pserve_cond.notify()
         except BaseException:
             # zero-orphan invariant: a failed enqueue sheds the span it
             # just opened before the raise escapes the frame
             if tid is not None:
                 RECORDER.terminal_shed(tid)
             raise
+        if rejected_cls is not None:
+            # everything below runs OUTSIDE the serving condition: the
+            # overload reply, the breadcrumb, and the span terminal
+            record_event(EVENT_ADMISSION_REJECT, plane="serving",
+                         cls=rejected_cls, req_id=req["req_id"])
+            try:
+                conn.sendall(protocol.encode_response(
+                    req["req_id"], protocol.STATUS_OVERLOAD, 0, 0, None))
+            except OSError:
+                with self._pserve_cond:
+                    self.stats["write_errors"] += 1
+            if tid is not None:
+                RECORDER.terminal_shed(tid)
 
     def _respond_error(self, conn: socket.socket, req_id: int,
                        status: int) -> None:
@@ -386,6 +458,10 @@ class PolicyInferenceServer(ConnRegistry):
             self._latency_ms.append(1e3 * (now - t_enq))
         breach = (pub_ts is not None
                   and (now - pub_ts) > self.sla_staleness_s)
+        late = 0
+        if self.sla_latency_ms is not None:
+            late = sum(1 for _, _, t_enq in batch
+                       if 1e3 * (now - t_enq) > self.sla_latency_ms)
         with self._pserve_cond:
             self.stats["batches"] += 1
             self.stats["rows"] += rows
@@ -393,6 +469,7 @@ class PolicyInferenceServer(ConnRegistry):
             self.stats["responses_ok"] += ok
             if breach:
                 self.stats["sla_breaches"] += 1
+            self.stats["latency_breaches"] += late
             self._occupancy.append(rows / bucket)
             self._batch_rows.append(rows)
 
@@ -418,6 +495,11 @@ class PolicyInferenceServer(ConnRegistry):
         with self._pserve_cond:
             out = dict(self.stats)
             out["queue_depth"] = len(self._pending)
+            out["admission_rejects_by_class"] = \
+                dict(self.admission_rejects_by_class)
+            out["admission_depth"] = self.admission_depth
+            out["batch_window_s"] = self.batch_window_s
+            out["max_batch_rows"] = self.max_batch_rows
             out["generation"] = self._generation
             out["version"] = self._version
             out["staleness_s"] = (

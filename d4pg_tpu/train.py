@@ -1039,6 +1039,7 @@ def train(cfg: ExperimentConfig) -> dict:
     # (generation, version) sequence (learner/aggregator.py).
     aggregator = None
     replica_failures: dict[int, int] = {}
+    pacing_dealer = None  # the sample-on-ingest dealer, if one stands up
     if cfg.learners > 1 or cfg.sample_on_ingest:
         if fused:
             # Unreachable for the device-dealt arm (it forces fused=False
@@ -1156,6 +1157,7 @@ def train(cfg: ExperimentConfig) -> dict:
                         beta_schedule=beta_sched,
                         min_size=max(1, cfg.batch_size), seed=cfg.seed)
                 service.attach_dealer(dealer)
+                pacing_dealer = dealer
             aggregator = Aggregator(
                 weights, mode=cfg.agg_mode, clip=cfg.agg_clip,
                 # actors pull acting params only; the full 4-subtree merge
@@ -1186,6 +1188,64 @@ def train(cfg: ExperimentConfig) -> dict:
                   + (f" sampler={dealt_arm}" if dealt_arm else ""),
                   flush=True)
 
+    # --- Elastic traffic plane (docs/architecture.md "Elastic traffic
+    # plane", --autoscale): the obs-driven control loop over whatever
+    # capacity knobs this run stood up. Sensing is the obs-registry
+    # export the planes already publish; actuation is each owner's
+    # bounded live setter (top-level lock acquires only), so the loop
+    # adds zero lock edges. Knobs without a wired actuator are still
+    # decided and ledgered — the journal shows what the controller
+    # WOULD have done on a fuller fleet.
+    autoscaler = None
+    # active-prefix replica scheduling: train_steps_multi fans each
+    # cycle across replicas[:target] only. ``parked`` remembers which
+    # replicas sat out a cycle so reactivation goes through respawn()
+    # — the idle epoch is fenced and any in-flight submission from
+    # before the scale-down bounces at the aggregator instead of
+    # landing as a stale surprise.
+    replica_target = {"n": max(1, len(replicas)), "parked": set()}
+    if cfg.autoscale:
+        from d4pg_tpu.elastic.autoscaler import Autoscaler, AutoscalerConfig
+
+        elastic_actuators: dict = {
+            "ingest_capacity": service.set_ingest_depth,
+        }
+        if policy_server is not None:
+            elastic_actuators["serving_rows"] = (
+                lambda v: policy_server.set_batch_limits(max_rows=v))
+            elastic_actuators["serving_window_s"] = (
+                lambda v: policy_server.set_batch_limits(window_s=v))
+        if pacing_dealer is not None:
+            elastic_actuators["dealer_deals"] = pacing_dealer.set_pacing
+        if replicas:
+            def _set_replica_target(n: int) -> None:
+                # autoscaler-thread side records the bounded target
+                # only; the train loop adopts it at the next cycle
+                # boundary (activation touches the aggregator's epoch
+                # table, which belongs to the round-owning thread)
+                replica_target["n"] = max(1, min(len(replicas), int(n)))
+
+            elastic_actuators["replicas"] = _set_replica_target
+        autoscaler = Autoscaler(
+            AutoscalerConfig(
+                interval_s=cfg.autoscale_interval_s,
+                # anchor the controller's set points at this run's
+                # startup knobs so tick 0 is a no-op on a calm fleet
+                serving_rows_init=cfg.serve_policy_max_rows,
+                serving_rows_min=max(16, cfg.serve_policy_max_rows // 4),
+                serving_rows_max=4 * cfg.serve_policy_max_rows,
+                serving_window_cold_s=cfg.serve_policy_window_s,
+                ingest_capacity_init=256,
+                ingest_capacity_min=64,
+                ingest_capacity_max=1024,
+                replicas_init=max(1, len(replicas)),
+                replicas_min=1,
+                replicas_max=max(1, len(replicas)),
+            ),
+            actuators=elastic_actuators).start()
+        print(f"elastic: autoscaler up, knobs="
+              f"{sorted(elastic_actuators)}", flush=True)
+
     def train_steps_multi(n: int):
         """Fan the cycle's n grad steps across the replicas: each runs
         ONE basis-adopt -> ceil(n/N) steps -> version-stamped submit
@@ -1194,7 +1254,17 @@ def train(cfg: ExperimentConfig) -> dict:
         the aggregator) and respawned at the next epoch, with the same
         consecutive-failure cap."""
         nonlocal state, lstep
-        per = -(-n // len(replicas))
+        # adopt the elastic replica target at this cycle boundary:
+        # replicas past the prefix sit the cycle out (parked); a parked
+        # replica coming back respawns first, fencing its idle epoch
+        active = replicas[:replica_target["n"]]
+        for r in replicas[len(active):]:
+            replica_target["parked"].add(r.replica_id)
+        for r in active:
+            if r.replica_id in replica_target["parked"]:
+                replica_target["parked"].discard(r.replica_id)
+                r.respawn()
+        per = -(-n // len(active))
         failed: dict[int, str] = {}
 
         def run_replica(r):
@@ -1206,12 +1276,12 @@ def train(cfg: ExperimentConfig) -> dict:
 
         threads = [
             threading.Thread(target=run_replica, args=(r,), daemon=True)
-            for r in replicas]
+            for r in active]
         for t in threads:
             t.start()
         for t in threads:
             t.join()
-        for r in replicas:
+        for r in active:
             if r.replica_id in failed:
                 fails = replica_failures.get(r.replica_id, 0) + 1
                 replica_failures[r.replica_id] = fails
@@ -1495,6 +1565,10 @@ def train(cfg: ExperimentConfig) -> dict:
     for p in actor_processes:
         if p is not None:
             p.join(timeout=5.0)
+    if autoscaler is not None:
+        # first: a tick firing mid-teardown would actuate knobs on
+        # planes that are already half-closed below
+        autoscaler.close()
     for r in replicas:
         r.close()
     if aggregator is not None:

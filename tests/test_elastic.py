@@ -1,0 +1,360 @@
+"""Elastic-traffic-plane tests (``d4pg_tpu/elastic``).
+
+The acceptance set for the scaling plane: seeded traffic-model
+determinism (two models from one config, bit-identical traces), the
+flash-crowd and heavy-tail shape pins, the class-aware admission
+policy's no-priority-inversion math, autoscaler hysteresis + cooldown
+on a scripted signal stream, the scaling-ledger replay oracle (and its
+tamper sensitivity), the live capacity setters the autoscaler drives,
+and the bench-artifact elastic schema gate over the committed A/B
+drill — the artifact where autoscaler-on must beat static on BOTH
+serving SLO breaches and ingest shed rows at equal seeded offered
+load.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from d4pg_tpu.elastic.admission import AdmissionPolicy
+from d4pg_tpu.elastic.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    ControlPolicy,
+    extract_signals,
+    replay_matches,
+)
+from d4pg_tpu.elastic.ledger import ScalingLedger, canonical_record
+from d4pg_tpu.elastic.traffic import TrafficConfig, TrafficModel
+
+pytestmark = pytest.mark.elastic
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- seeded traffic model ---------------------------------------------------
+
+def test_traffic_model_deterministic():
+    """Two models built from the same config emit bit-identical offered
+    load — per lane and fleet-summed — and a different seed does not
+    (the replay contract the A/B drill's equal-offered-load claim
+    stands on)."""
+    cfg = TrafficConfig(seed=7, n_actors=6, diurnal_amp=0.3,
+                        flash_rate_per_s=0.5, horizon_s=30.0)
+    a, b = TrafficModel(cfg), TrafficModel(cfg)
+    for lane in range(cfg.n_actors):
+        assert np.array_equal(a.trace(lane, 20.0, 0.1),
+                              b.trace(lane, 20.0, 0.1))
+    assert np.array_equal(a.fleet_trace(20.0, 0.1), b.fleet_trace(20.0, 0.1))
+    assert a.flash_events() == b.flash_events()
+    other = TrafficModel(TrafficConfig(seed=8, n_actors=6, diurnal_amp=0.3,
+                                       flash_rate_per_s=0.5, horizon_s=30.0))
+    assert not np.array_equal(a.fleet_trace(20.0, 0.1),
+                              other.fleet_trace(20.0, 0.1))
+
+
+def test_flash_crowd_shape():
+    """A scripted crowd multiplies the rate by its amplitude exactly
+    while active and leaves it untouched outside; overlapping crowds
+    take the max, not the product."""
+    cfg = TrafficConfig(seed=0, n_actors=1, diurnal_amp=0.0,
+                        pareto_alpha=1e9,  # weight -> 1: isolate the flash
+                        flash_schedule=((2.0, 1.0, 6.0), (2.5, 1.0, 4.0)))
+    m = TrafficModel(cfg)
+    base = m.rate(0, 0.0)
+    assert base == pytest.approx(cfg.base_rows_per_sec, rel=1e-6)
+    assert m.rate(0, 2.4) == pytest.approx(6.0 * base)
+    assert m.rate(0, 2.7) == pytest.approx(6.0 * base)  # overlap: max(6,4)
+    assert m.rate(0, 3.2) == pytest.approx(4.0 * base)  # first crowd over
+    assert m.rate(0, 4.0) == pytest.approx(base)        # both over
+
+
+def test_pareto_tail_and_floor():
+    """The per-actor weights are a normalized heavy tail: fleet mean
+    pinned at 1.0 (offered fleet load independent of the draw), a few
+    hot lanes well above the median, and the rate floor holds through
+    the deepest diurnal trough."""
+    cfg = TrafficConfig(seed=3, n_actors=256, pareto_alpha=1.5)
+    m = TrafficModel(cfg)
+    w = np.array([m.pareto_weight(i) for i in range(cfg.n_actors)])
+    assert w.mean() == pytest.approx(1.0)
+    assert np.all(w > 0)
+    assert w.max() / np.median(w) > 3.0  # the "few hot lanes" shape
+    # Hill-style sanity: the top decile carries an outsized share for
+    # alpha=1.5 (would be ~10% under a uniform fleet)
+    top = np.sort(w)[-cfg.n_actors // 10:]
+    assert top.sum() / w.sum() > 0.2
+    floor = TrafficModel(TrafficConfig(
+        seed=3, n_actors=1, diurnal_amp=1.0, min_rows_per_sec=5.0,
+        base_rows_per_sec=1.0))
+    ts = np.arange(0.0, 120.0, 0.25)
+    assert min(floor.rate(0, float(t)) for t in ts) >= 5.0
+
+
+def test_renewal_flash_stream():
+    """The unscripted flash stream is a seeded renewal process: every
+    event lands inside the horizon with positive duration and an
+    amplitude inside the configured band, and the stream replays."""
+    cfg = TrafficConfig(seed=11, flash_rate_per_s=0.5, horizon_s=40.0,
+                        flash_duration_s=(1.0, 2.0), flash_amp=(3.0, 5.0))
+    ev = TrafficModel(cfg).flash_events()
+    assert ev and ev == TrafficModel(cfg).flash_events()
+    for start, dur, amp in ev:
+        assert 0.0 < start < cfg.horizon_s
+        assert 1.0 <= dur <= 2.0
+        assert 3.0 <= amp <= 5.0
+
+
+# --- admission policy -------------------------------------------------------
+
+def test_admission_policy_classes():
+    pol = AdmissionPolicy()
+    assert [pol.classify_index(i) for i in range(4)] == [0, 1, 0, 1]
+    # the fleet's trailing-int identity convention classifies by index
+    assert pol.classify_actor("actor-3") == pol.classify_index(3)
+    assert pol.classify_actor("proc-12") == pol.classify_index(12)
+    # no trailing int: stable crc32 fallback (same across processes)
+    assert (pol.classify_actor("learner")
+            == pol.classify_actor("learner"))
+    assert pol.class_name(0) == "rt" and pol.class_name(1) == "bulk"
+    # bulk gets half the depth budget, floored at 1
+    assert pol.depth_for(0, 96) == 96
+    assert pol.depth_for(1, 96) == 48
+    assert pol.depth_for(1, 1) == 1
+    with pytest.raises(ValueError):
+        AdmissionPolicy(classes=("a",), depth_fracs=(1.0, 0.5))
+    with pytest.raises(ValueError):
+        AdmissionPolicy(classes=("a", "b"), depth_fracs=(1.0, 0.0))
+
+
+def test_shed_victim_no_priority_inversion():
+    pol = AdmissionPolicy()
+    # oldest item of the worst class present is the victim
+    assert pol.shed_victim([0, 1, 0, 1], incoming_cls=0) == 1
+    # incoming outranked by nothing queued: caller rejects the incoming
+    # instead of evicting better-class work
+    assert pol.shed_victim([0, 0, 0], incoming_cls=1) is None
+    assert pol.shed_victim([], incoming_cls=0) is None
+    # equal class is NOT an inversion — oldest equal-class item goes
+    assert pol.shed_victim([1, 1], incoming_cls=1) == 0
+
+
+# --- autoscaler + ledger ----------------------------------------------------
+
+def _signals(queue=0.0, p95=0.0, depth=0.0, sheds=0.0):
+    return {"serving_queue": queue, "serving_p95_ms": p95,
+            "ingest_depth_frac": depth, "ingest_sheds": sheds}
+
+
+def test_control_policy_hysteresis_and_cooldown():
+    cfg = AutoscalerConfig(serving_rows_init=32, serving_rows_min=16,
+                           serving_rows_max=128, cooldown_ticks=2)
+    pol = ControlPolicy(cfg)
+    state = pol.initial_state()
+    hot = _signals(queue=cfg.queue_high + 1)
+    dec, state = pol.decide(hot, state)
+    assert dec["serving_rows"] == 64  # one doubling per move
+    assert dec["serving_window_s"] == cfg.serving_window_hot_s
+    # still hot, but inside the cooldown: no move
+    dec, state = pol.decide(hot, state)
+    assert "serving_rows" not in dec
+    dec, state = pol.decide(hot, state)
+    assert dec["serving_rows"] == 128
+    # pinned at max from here
+    dec, state = pol.decide(hot, state)
+    dec, state = pol.decide(hot, state)
+    assert "serving_rows" not in dec
+    # the hysteresis gap: a calm-but-not-cold plane holds position
+    mid = _signals(queue=(cfg.queue_low + cfg.queue_high) // 2)
+    for _ in range(4):
+        dec, state = pol.decide(mid, state)
+        assert "serving_rows" not in dec
+    cold = _signals()
+    dec, state = pol.decide(cold, state)
+    assert dec["serving_rows"] == 64
+    assert dec["serving_window_s"] == cfg.serving_window_cold_s
+
+
+def test_control_policy_ingest_and_dealer():
+    """Ingest pressure deepens the shards AND paces the dealer down
+    (the commit thread's lock windows go to draining); calm reverses
+    both. A shed-counter delta alone counts as pressure."""
+    cfg = AutoscalerConfig(ingest_capacity_init=64, dealer_deals_init=2,
+                           dealer_deals_max=4, cooldown_ticks=0)
+    pol = ControlPolicy(cfg)
+    state = pol.initial_state()
+    dec, state = pol.decide(_signals(sheds=5.0), state)  # delta 5 > 0
+    assert dec["ingest_capacity"] == 128
+    assert dec["dealer_deals"] == 1
+    # same cumulative counter: delta 0 now, depth calm -> scale back
+    dec, state = pol.decide(_signals(sheds=5.0), state)
+    assert dec["ingest_capacity"] == 64
+    assert dec["dealer_deals"] == 2
+
+
+def test_extract_signals_total():
+    """A missing provider, a provider_error section, or garbage values
+    read as a calm plane — the controller degrades to do-nothing, its
+    thread never dies on a half-built registry export."""
+    assert extract_signals({}) == _signals()
+    assert extract_signals({"serving": {"provider_error": "x"},
+                            "ingest": None}) == _signals()
+    sig = extract_signals({
+        "serving": {"queue_depth": 3, "latency_ms": {"p95": "nan?"}},
+        "ingest": {"sheds": 2, "admit_fails": 1,
+                   "per_shard": [{"queue_depth": 5, "capacity": 10},
+                                 {"queue_depth": 1, "capacity": 0}]},
+    })
+    assert sig["serving_queue"] == 3.0
+    assert sig["serving_p95_ms"] == 0.0  # unparsable -> calm
+    assert sig["ingest_depth_frac"] == 0.5  # max over shards, 0-cap skipped
+    assert sig["ingest_sheds"] == 3.0
+
+
+def test_ledger_replay_oracle_and_tamper():
+    """Driving the autoscaler from a scripted sensor yields a ledger the
+    pure controller reproduces bit for bit; the digest pins across two
+    identical runs; a tampered decision breaks the oracle."""
+    cfg = AutoscalerConfig(cooldown_ticks=1)
+    script = ([_signals(queue=50.0, p95=80.0)] * 4
+              + [_signals()] * 4
+              + [_signals(depth=0.9, sheds=3.0)] * 4)
+
+    def run_once():
+        scaler = Autoscaler(
+            cfg, actuators={},
+            sensor=lambda: {},  # replaced per tick below
+            ledger=ScalingLedger(), register_provider=False)
+        for sig in script:
+            scaler._sensor = lambda s=sig: {
+                "serving": {"queue_depth": s["serving_queue"],
+                            "latency_ms": {"p95": s["serving_p95_ms"]}},
+                "ingest": {"sheds": s["ingest_sheds"], "admit_fails": 0,
+                           "per_shard": [{"queue_depth": s["ingest_depth_frac"],
+                                          "capacity": 1.0}]},
+            }
+            scaler.tick_once()
+        return scaler
+
+    a, b = run_once(), run_once()
+    assert len(a.ledger) == len(script)
+    assert replay_matches(cfg, a.ledger)
+    assert a.ledger.digest() == b.ledger.digest()
+    stats = a.autoscaler_stats()
+    assert stats["decisions"] > 0 and stats["actuations"] == 0
+    # tamper: flip one recorded decision -> the replay oracle fails
+    recs = a.ledger.records()
+    victim = next(r for r in recs if r["decisions"])
+    tampered = ScalingLedger()
+    for r in recs:
+        if r is victim:
+            r = dict(r, decisions={k: v + 1
+                                   for k, v in r["decisions"].items()})
+        tampered.append(r)
+    assert not replay_matches(cfg, tampered)
+    assert tampered.digest() != a.ledger.digest()
+    # wall time rides the record but stays out of the canonical stream
+    assert "t_wall" in recs[0] and "t_wall" not in canonical_record(recs[0])
+
+
+def test_autoscaler_actuation_bounded_and_contained():
+    """Wired actuators receive exactly the decided targets; an actuator
+    that raises is degrade-and-count (journaled in the record's errors,
+    loop alive); unknown knob names fail at construction."""
+    cfg = AutoscalerConfig(cooldown_ticks=0)
+    seen: list = []
+
+    def boom(v):
+        raise RuntimeError("actuator down")
+
+    scaler = Autoscaler(
+        cfg,
+        actuators={"serving_rows": seen.append, "ingest_capacity": boom},
+        sensor=lambda: {"serving": {"queue_depth": 99,
+                                    "latency_ms": {"p95": 500.0}},
+                        "ingest": {"sheds": 1, "per_shard": [
+                            {"queue_depth": 9, "capacity": 10}]}},
+        register_provider=False)
+    rec = scaler.tick_once()
+    assert seen == [rec["decisions"]["serving_rows"]]
+    assert rec["errors"] and "ingest_capacity" in rec["errors"][0]
+    assert scaler.stats["actuator_errors"] == 1
+    # the errored knob's decision is still journaled + replay-covered
+    assert replay_matches(cfg, scaler.ledger)
+    with pytest.raises(ValueError):
+        Autoscaler(cfg, actuators={"warp_factor": seen.append},
+                   register_provider=False)
+
+
+def test_live_capacity_setters():
+    """The actuation surface the autoscaler drives: ingest-depth resize
+    recomputes the shed watermark under the shard conds and dealer
+    pacing clamps at >= 1 — both safe mid-flight."""
+    from d4pg_tpu.distributed.replay_service import ReplayService
+    from d4pg_tpu.replay.uniform import ReplayBuffer
+    from d4pg_tpu.replay.sampler import SampleDealer
+    from d4pg_tpu.replay.staging import DealtBlockRing
+
+    svc = ReplayService(ReplayBuffer(512, 3, 2, seed=0), ingest_capacity=8,
+                        shed_watermark=0.75, num_ingest_shards=2)
+    try:
+        svc.set_ingest_depth(64)
+        stats = svc.ingest_stats()
+        assert stats["ingest_capacity"] == 64
+        for sh in stats["per_shard"]:
+            assert sh["capacity"] == 64 and sh["shed_at"] == 48
+        svc.set_ingest_depth(0)  # clamps, never a zero-capacity shard
+        assert svc.ingest_stats()["ingest_capacity"] == 1
+    finally:
+        svc.close()
+    dealer = SampleDealer(512, [DealtBlockRing(2)], n_shards=1, k=2,
+                          batch_size=4, min_size=4, seed=0)
+    dealer.set_pacing(3)
+    assert dealer.max_deals_per_tick == 3
+    dealer.set_pacing(-5)
+    assert dealer.max_deals_per_tick == 1
+
+
+# --- the committed artifact -------------------------------------------------
+
+def test_elastic_artifact_schema():
+    """The newest committed elastic artifact must carry the full A/B
+    story with the gate PASSING: at equal seeded offered load the
+    autoscaler arm has strictly fewer serving SLO breaches AND strictly
+    fewer ingest shed rows, every shed is class-attributed, the scaling
+    ledger replays bit-identically, and the run-gating oracles (lock
+    hierarchy, crash containment, trace orphans) are all clean. A later
+    PR that regresses any of it fails tier-1 here."""
+    arts = sorted(glob.glob(os.path.join(
+        REPO_ROOT, "docs", "evidence", "elastic", "elastic_*.json")))
+    assert arts, "no committed elastic artifact"
+    with open(arts[-1]) as f:
+        art = json.load(f)
+    assert art["metric"] == "fleet_elastic" and art["schema"] == 1
+    assert art["offered_deterministic"] is True
+    assert len(art["offered_rows_per_s"]) >= 16
+    drill = art["drill"]
+    assert drill["metric"] == "elastic_chaos" and drill["schema"] == 1
+    gate = drill["ab_gate"]
+    assert gate["pass"] is True
+    assert gate["slo_breaches_elastic"] < gate["slo_breaches_static"]
+    assert gate["shed_rows_elastic"] < gate["shed_rows_static"]
+    assert drill["hierarchy_violations"] == 0
+    assert drill["contained_crashes"] == 0
+    assert drill["trace"]["orphans"] == 0
+    for arm_name in ("static", "elastic"):
+        arm = drill["arms"][arm_name]
+        assert arm["requests"]["sent"] > 0
+        # every shed/reject is attributed to a class on both planes
+        ing = arm["ingest"]
+        if ing["shed_rows"] or ing["admit_fails"]:
+            assert sum(ing["sheds_by_class"].values()) > 0
+    elastic_arm = drill["arms"]["elastic"]["autoscaler"]
+    assert elastic_arm["ledger_replay_ok"] is True
+    assert elastic_arm["ticks"] > 0 and elastic_arm["actuations"] > 0
+    assert elastic_arm["ledger_digest"]

@@ -31,13 +31,16 @@ def debug_mode():
 
 
 def test_hierarchy_table_shape():
-    # outermost first, strictly decreasing: the five ingest-plane tiers
-    # plus the multi-learner pair (replica > aggregator), the weight
-    # plane's three (relay > server cache > store), and the serving
-    # plane's condition wedged between the weight server and the store
-    assert list(HIERARCHY) == ["service", "buffer", "replica", "agg",
-                               "commit", "wrelay", "wserve", "pserve",
-                               "wstore", "shard", "sampler", "ring"]
+    # outermost first, strictly decreasing: the elastic control plane
+    # above everything (the autoscaler may never be climbed INTO from a
+    # data-plane lock), the five ingest-plane tiers, the multi-learner
+    # pair (replica > aggregator), the weight plane's three (relay >
+    # server cache > store), and the serving plane's condition wedged
+    # between the weight server and the store
+    assert list(HIERARCHY) == ["elastic", "service", "buffer", "replica",
+                               "agg", "commit", "wrelay", "wserve",
+                               "pserve", "wstore", "shard", "sampler",
+                               "ring"]
     tiers = list(HIERARCHY.values())
     assert tiers == sorted(tiers, reverse=True)
     assert len(set(tiers)) == len(tiers)
