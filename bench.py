@@ -744,7 +744,9 @@ def bench_reference_torch_cpu(steps: int = 20) -> float | None:
 
     obs = torch.randn(BATCH, OBS_DIM)
     act = torch.rand(BATCH, ACT_DIM) * 2 - 1
-    rew = np.random.randn(BATCH).astype(np.float64)
+    # seeded component stream, not numpy's ambient global (jaxlint 22):
+    # the torch baseline must replay bit-for-bit like every other arm
+    rew = np.random.default_rng(0).standard_normal(BATCH).astype(np.float64)
     v_min, v_max = 0.0, 800.0
     delta = (v_max - v_min) / (N_ATOMS - 1)
     bins = np.linspace(v_min, v_max, N_ATOMS)

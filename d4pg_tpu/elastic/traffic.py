@@ -32,6 +32,8 @@ import math
 
 import numpy as np
 
+from d4pg_tpu.obs.draw_ledger import LEDGER
+
 # SeedSequence spawn-key tags (disjoint from the chaos planes' 0x5E11 /
 # 0xD4B0 / 0xD4E4 / 0xD4E5 tags): diurnal phase, flash-crowd event
 # stream, per-actor Pareto weights.
@@ -86,17 +88,21 @@ class TrafficModel:
 
     def __init__(self, cfg: TrafficConfig):
         self.cfg = cfg
-        # diurnal phase: one uniform draw on its own branch
-        d_rng = np.random.default_rng(
-            np.random.SeedSequence(cfg.seed, spawn_key=(_TAG_DIURNAL, 0)))
+        # diurnal phase: one uniform draw on its own branch (all three
+        # construction streams are ledger-wrapped: their draw counts are
+        # config-deterministic, so the A/B drivers can pin the
+        # schedule.* digest across arms as the equal-load oracle)
+        d_rng = LEDGER.wrap("schedule.traffic.diurnal", np.random.default_rng(
+            np.random.SeedSequence(cfg.seed, spawn_key=(_TAG_DIURNAL, 0))))
         self._diurnal_phase = float(d_rng.random())
         # per-actor Pareto weights, one branch per actor (adding lanes
         # extends the weight vector without disturbing existing lanes'
         # draws), normalized to mean 1.0
         raw = np.empty(max(1, cfg.n_actors), np.float64)
         for i in range(raw.shape[0]):
-            rng = np.random.default_rng(
-                np.random.SeedSequence(cfg.seed, spawn_key=(_TAG_PARETO, i)))
+            rng = LEDGER.wrap(
+                "schedule.traffic.pareto", np.random.default_rng(
+                    np.random.SeedSequence(cfg.seed, spawn_key=(_TAG_PARETO, i))))
             u = rng.random()
             raw[i] = (1.0 - u) ** (-1.0 / cfg.pareto_alpha)
         self._weights = raw / raw.mean()
@@ -106,8 +112,9 @@ class TrafficModel:
             self._flash = [(float(s), float(d), float(a))
                            for s, d, a in cfg.flash_schedule]
         else:
-            f_rng = np.random.default_rng(
-                np.random.SeedSequence(cfg.seed, spawn_key=(_TAG_FLASH, 0)))
+            f_rng = LEDGER.wrap(
+                "schedule.traffic.flash", np.random.default_rng(
+                    np.random.SeedSequence(cfg.seed, spawn_key=(_TAG_FLASH, 0))))
             events = []
             t = 0.0
             rate = max(1e-9, cfg.flash_rate_per_s)

@@ -31,6 +31,8 @@ from typing import NamedTuple
 
 import numpy as np
 
+from d4pg_tpu.obs.draw_ledger import LEDGER
+
 
 @dataclasses.dataclass(frozen=True)
 class ChaosConfig:
@@ -107,8 +109,10 @@ class ActorChaos:
     def __init__(self, config: ChaosConfig, actor_index: int, actor_id: str):
         self.config = config
         self.actor_id = actor_id
-        self._rng = np.random.default_rng(
-            np.random.SeedSequence(config.seed, spawn_key=(actor_index,)))
+        # ledger-wrapped so every chaos run reports per-actor draw
+        # counts (obs.draw_ledger; runtime twin of jaxlint family 24)
+        self._rng = LEDGER.wrap(f"chaos.{actor_id}", np.random.default_rng(
+            np.random.SeedSequence(config.seed, spawn_key=(actor_index,))))
         self.log: list[ChaosEvent] = []
         self._i = 0
 
@@ -163,8 +167,8 @@ class ChaosPolicy:
         cfg = self.config
         if not cfg.service_chaos_enabled():
             return []
-        rng = np.random.default_rng(
-            np.random.SeedSequence(cfg.seed, spawn_key=(0x5E11,)))
+        rng = LEDGER.wrap("schedule.service_kill", np.random.default_rng(
+            np.random.SeedSequence(cfg.seed, spawn_key=(0x5E11,))))
         out = []
         for i in range(cfg.service_kill_count):
             base = (i + 1) * cfg.service_kill_every_s

@@ -58,6 +58,7 @@ from d4pg_tpu.elastic.autoscaler import replay_matches
 from d4pg_tpu.learner.state import D4PGConfig, init_state
 from d4pg_tpu.learner.update import act_deterministic
 from d4pg_tpu.obs.containment import contained_crash
+from d4pg_tpu.obs.draw_ledger import LEDGER
 from d4pg_tpu.obs.flight import record_event
 from d4pg_tpu.obs.registry import REGISTRY, percentile_summary
 from d4pg_tpu.obs.trace import RECORDER as TRACE, new_trace_id
@@ -362,6 +363,10 @@ def _run_arm(cfg: ElasticChaosConfig, elastic: bool) -> dict:
     """One arm: identical offered load and environment; the autoscaler
     runs only when ``elastic``."""
     agent_cfg = cfg.agent_config()
+    # fresh draw-count window per arm: every counted draw in this arm
+    # is a construction-time (config-deterministic) TrafficModel draw,
+    # so the gate can pin full-digest equality across arms
+    LEDGER.reset(armed=True)
     policy = AdmissionPolicy()
     store = WeightStore()
     store.publish(init_state(agent_cfg,
@@ -482,6 +487,7 @@ def _run_arm(cfg: ElasticChaosConfig, elastic: bool) -> dict:
                                                autoscaler.ledger),
             "ledger_tail": autoscaler.ledger.to_jsonable(tail=8),
         }
+    arm["draw_ledger"] = LEDGER.export()
     server.close()
     service.close()
     return arm
@@ -522,10 +528,17 @@ def run_elastic_chaos(cfg: ElasticChaosConfig | None = None, **overrides
         "slo_breaches_elastic": slo(arms["elastic"]),
         "shed_rows_static": arms["static"]["ingest"]["shed_rows"],
         "shed_rows_elastic": arms["elastic"]["ingest"]["shed_rows"],
+        # equal-seeded-load oracle: both arms constructed their traffic
+        # models from the same config, so their counted RNG draw
+        # histories must hash identically — a mismatch means the arms
+        # were not compared under the same offered load
+        "draw_digest_equal": (arms["static"]["draw_ledger"]["digest"]
+                              == arms["elastic"]["draw_ledger"]["digest"]),
     }
     gate["pass"] = bool(
         gate["slo_breaches_elastic"] < gate["slo_breaches_static"]
-        and gate["shed_rows_elastic"] < gate["shed_rows_static"])
+        and gate["shed_rows_elastic"] < gate["shed_rows_static"]
+        and gate["draw_digest_equal"])
 
     trace_block = TRACE.latency_block()
     TRACE.disable()

@@ -36,6 +36,7 @@ from d4pg_tpu.distributed.transport import TransitionReceiver
 from d4pg_tpu.elastic.traffic import TrafficConfig, TrafficModel
 from d4pg_tpu.fleet.chaos import ChaosConfig, ChaosPolicy, StallGate
 from d4pg_tpu.fleet.sender import ThrottledSender, synthetic_block
+from d4pg_tpu.obs import draw_ledger as obs_draw
 from d4pg_tpu.obs import flight as obs_flight
 from d4pg_tpu.obs.containment import contained_crash
 from d4pg_tpu.obs import trace as obs_trace
@@ -209,9 +210,11 @@ class FleetHarness:
 
     # -- observability plane -----------------------------------------------
     def _arm_obs(self) -> None:
-        """Reset + arm the flight recorder (always) and the trace
-        recorder (when ``trace_sample`` > 0) for this run."""
+        """Reset + arm the flight recorder (always), the draw ledger
+        (always — every chaos run reports per-stream RNG draw counts),
+        and the trace recorder (when ``trace_sample`` > 0)."""
         cfg = self.config
+        obs_draw.LEDGER.reset(armed=True)
         obs_flight.RECORDER.reset()
         obs_flight.record_event(
             "fleet_run_start", n_actors=cfg.n_actors, mode=cfg.mode,
@@ -831,6 +834,9 @@ class FleetHarness:
                                  for lane in lanes),
             "flight_dump": flight_dump,
             "flight_events": len(obs_flight.RECORDER),
+            # per-stream RNG draw counts + canonical digests: the A/B
+            # drivers pin schedule_digest equality across arms
+            "draw_ledger": obs_draw.LEDGER.export(),
             "ticks": sum(lane["ticks"] for lane in lanes),
             "chaos": dataclasses.asdict(cfg.chaos),
             "seed": cfg.chaos.seed,

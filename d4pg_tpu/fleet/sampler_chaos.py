@@ -58,6 +58,7 @@ from d4pg_tpu.fleet.chaos import ChaosConfig
 from d4pg_tpu.fleet.harness import FleetConfig, FleetHarness
 from d4pg_tpu.fleet.sender import synthetic_block
 from d4pg_tpu.obs.containment import contained_crash
+from d4pg_tpu.obs.draw_ledger import LEDGER
 from d4pg_tpu.obs.flight import record_event
 from d4pg_tpu.obs.trace import RECORDER as TRACE
 from d4pg_tpu.replay.prioritized import PrioritizedReplayBuffer
@@ -108,8 +109,8 @@ class SamplerChaosConfig:
         of the run, each jittered +-25% of its slot."""
         if self.learner_kills <= 0:
             return []
-        rng = np.random.default_rng(
-            np.random.SeedSequence(self.seed, spawn_key=(0xD4B0,)))
+        rng = LEDGER.wrap("schedule.sampler_kill", np.random.default_rng(
+            np.random.SeedSequence(self.seed, spawn_key=(0xD4B0,))))
         span = 0.8 * self.duration_s
         slot = span / self.learner_kills
         return sorted(0.1 * self.duration_s + (i + 0.5) * slot
@@ -385,6 +386,9 @@ def run_sampler_chaos(cfg: SamplerChaosConfig | None = None,
         "hierarchy_violations": (locks["hierarchy_violations"]
                                  if locks else None),
         "trace_orphans": lat.get("orphans"),
+        # schedule_digest is config-deterministic: two arms at the same
+        # seed/config must report the same value (the A/B equal-load pin)
+        "draw_ledger": result["draw_ledger"],
         "seed": cfg.seed,
     }
     return report

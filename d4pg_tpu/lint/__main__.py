@@ -21,7 +21,13 @@ dataflow table, donation sites) from the mesh pass (families 19-21) —
 the review artifact for sharding-touching PRs; exit 1 iff any mesh
 family fires.
 
-``--all`` runs the syntactic families AND all five graph modes and
+``--rng`` prints the RNG stream table (owner, constructor, seed
+provenance, draw sites, thread reachability) and SeedSequence branch
+sites from the determinism pass (families 22-24) — the review artifact
+for chaos/traffic/sampler-touching PRs; exit 1 iff any rng family
+fires.
+
+``--all`` runs the syntactic families AND all six graph modes and
 emits ONE merged document — the single entrypoint CI gates on.
 
 ``--json`` switches any mode to a machine-readable document on stdout:
@@ -41,6 +47,7 @@ from d4pg_tpu.lint.engine import (
     build_fail_graph,
     build_lock_graph,
     build_mesh_graph,
+    build_rng_graph,
     build_wire_graph,
     lint_paths,
 )
@@ -123,6 +130,19 @@ def _mesh_extra(graph) -> dict:
     }
 
 
+def _rng_extra(graph) -> dict:
+    return {
+        "functions": graph.functions, "modules": graph.modules,
+        "scoped": graph.scoped,
+        "streams": [{"site": s, "owner": o, "ctor": c, "seed": sd,
+                     "draws": d, "threads": t}
+                    for s, o, c, sd, d, t in sorted(graph.streams)],
+        "branches": [{"site": s, "src": x}
+                     for s, x in sorted(graph.branches)],
+        "handlers": dict(sorted(graph.handlers.items())),
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m d4pg_tpu.lint",
@@ -156,8 +176,14 @@ def main(argv: list[str] | None = None) -> int:
                              "sharding dataflow, donation sites; "
                              "families 19-21) instead of findings; exit "
                              "1 iff any mesh family fires")
+    parser.add_argument("--rng", action="store_true", dest="rng_mode",
+                        help="print the RNG stream/provenance table "
+                             "(owners, seed provenance, draw sites, "
+                             "thread reachability; families 22-24) "
+                             "instead of findings; exit 1 iff any rng "
+                             "family fires")
     parser.add_argument("--all", action="store_true", dest="all_modes",
-                        help="run the syntactic families AND all five "
+                        help="run the syntactic families AND all six "
                              "graph modes; emit ONE merged document "
                              "(--json) or every artifact in sequence")
     parser.add_argument("--json", action="store_true",
@@ -229,10 +255,25 @@ def main(argv: list[str] | None = None) -> int:
                 print(e, file=sys.stderr)
         return 1 if graph.findings else 0
 
+    if args.rng_mode:
+        from d4pg_tpu.lint.rnggraph import format_rnggraph
+
+        graph, errors = build_rng_graph(paths)
+        if args.json:
+            print(json.dumps(_doc(
+                "rng", graph.findings, errors,
+                **_rng_extra(graph)), indent=2))
+        else:
+            print(format_rnggraph(graph))
+            for e in errors:
+                print(e, file=sys.stderr)
+        return 1 if graph.findings else 0
+
     if args.all_modes:
         from d4pg_tpu.lint.failgraph import format_failgraph
         from d4pg_tpu.lint.lockgraph import format_graph
         from d4pg_tpu.lint.meshgraph import format_meshgraph
+        from d4pg_tpu.lint.rnggraph import format_rnggraph
         from d4pg_tpu.lint.wiregraph import format_registry
 
         result = lint_paths(paths)
@@ -240,6 +281,7 @@ def main(argv: list[str] | None = None) -> int:
         wire, wire_errs = build_wire_graph(paths)
         fail, fail_errs = build_fail_graph(paths)
         mesh, mesh_errs = build_mesh_graph(paths)
+        rng, rng_errs = build_rng_graph(paths)
         # lint_paths already runs every program family, so its findings
         # list IS the merged findings list; the per-mode sections carry
         # the review artifacts (and re-state each mode's own findings)
@@ -256,17 +298,20 @@ def main(argv: list[str] | None = None) -> int:
                 fail={"findings": [_finding_doc(f) for f in fail.findings],
                       "errors": fail_errs, **_fail_extra(fail)},
                 mesh={"findings": [_finding_doc(f) for f in mesh.findings],
-                      "errors": mesh_errs, **_mesh_extra(mesh)}),
+                      "errors": mesh_errs, **_mesh_extra(mesh)},
+                rng={"findings": [_finding_doc(f) for f in rng.findings],
+                     "errors": rng_errs, **_rng_extra(rng)}),
                 indent=2))
             return 1 if dirty else 0
         for block in (format_graph(locks), format_registry(wire),
-                      format_failgraph(fail), format_meshgraph(mesh)):
+                      format_failgraph(fail), format_meshgraph(mesh),
+                      format_rnggraph(rng)):
             print(block)
             print()
         for f in result.findings:
             print(f.format())
         for e in (result.errors + lock_errs + wire_errs + fail_errs
-                  + mesh_errs):
+                  + mesh_errs + rng_errs):
             print(e, file=sys.stderr)
         n, s = len(result.findings), len(result.suppressed)
         print(f"jaxlint: {n} finding(s), {s} suppressed", file=sys.stderr)

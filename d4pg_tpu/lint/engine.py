@@ -71,14 +71,16 @@ def _run_program_rules(ctxs: list[ModuleContext], program_ids: list[str],
         return
     from d4pg_tpu.lint.failgraph import FAIL_RULES
     from d4pg_tpu.lint.meshgraph import MESH_RULES
+    from d4pg_tpu.lint.rnggraph import RNG_RULES
     from d4pg_tpu.lint.wiregraph import WIRE_RULES
 
     lock_ids = [r for r in program_ids
                 if r not in WIRE_RULES and r not in FAIL_RULES
-                and r not in MESH_RULES]
+                and r not in MESH_RULES and r not in RNG_RULES]
     wire_ids = [r for r in program_ids if r in WIRE_RULES]
     fail_ids = [r for r in program_ids if r in FAIL_RULES]
     mesh_ids = [r for r in program_ids if r in MESH_RULES]
+    rng_ids = [r for r in program_ids if r in RNG_RULES]
     per_file: dict[str, list[Finding]] = {}
     if lock_ids:
         from d4pg_tpu.lint import lockgraph
@@ -99,6 +101,11 @@ def _run_program_rules(ctxs: list[ModuleContext], program_ids: list[str],
         from d4pg_tpu.lint import meshgraph
 
         for f in meshgraph.analyze(ctxs, rules=mesh_ids).findings:
+            per_file.setdefault(f.file, []).append(f)
+    if rng_ids:
+        from d4pg_tpu.lint import rnggraph
+
+        for f in rnggraph.analyze(ctxs, rules=rng_ids).findings:
             per_file.setdefault(f.file, []).append(f)
     for path, found in sorted(per_file.items()):
         _sift(found, sups.get(path, Suppressions()), result)
@@ -225,4 +232,25 @@ def build_mesh_graph(paths: list[str]):
         except (OSError, SyntaxError) as e:
             errors.append(f"{path}: {e}")
     graph = meshgraph.analyze(ctxs)
+    return graph, errors
+
+
+def build_rng_graph(paths: list[str]):
+    """The ``--rng`` review artifact: the discovered RNG stream table
+    (owner, constructor, seed provenance, draw sites, thread
+    reachability) and SeedSequence branch sites over ``paths`` (plus
+    findings from families 22-24 and the interprocedural key-reuse
+    check)."""
+    from d4pg_tpu.lint import rnggraph
+
+    ctxs: list[ModuleContext] = []
+    errors: list[str] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            ctxs.append(build_context(path, source))
+        except (OSError, SyntaxError) as e:
+            errors.append(f"{path}: {e}")
+    graph = rnggraph.analyze(ctxs)
     return graph, errors

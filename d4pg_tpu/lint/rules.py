@@ -1,6 +1,6 @@
 """The jaxlint rule catalog.
 
-Twenty-one rule families, each targeting a hazard that silently costs
+Twenty-four rule families, each targeting a hazard that silently costs
 throughput or correctness on this stack (see docs/architecture.md "Static
 analysis & perf sentinels" for the rationale and suppression policy):
 
@@ -35,13 +35,23 @@ analysis & perf sentinels" for the rationale and suppression policy):
   different partition factory (implicit reshard)
 - ``donation-alias``       — donate_argnums call whose donated argument
   aliases another argument or a live captured reference
+- ``rng-ambient-stream``   — numpy/stdlib global-RNG draw, unseeded
+  ctor, or wall-clock seed inside determinism-scoped code
+- ``rng-stream-thread-escape`` — one Generator drawn from two
+  thread-spawn targets without its own SeedSequence branch;
+  ``# jaxlint: stream-owner=<Component.attr>`` declares a caller-owned
+  branch
+- ``rng-draw-count-drift`` — seeded stream drawn a path-dependent
+  count per event (the PR-12 desync shape); only skip-before-RNG-use
+  is clean
 
-The last twelve are PROGRAM-scope families implemented in
+The last fifteen are PROGRAM-scope families implemented in
 ``lint/lockgraph.py`` (locks), ``lint/wiregraph.py`` (wire protocol),
-``lint/failgraph.py`` (exception flow / ledger) and ``lint/meshgraph.py``
-(sharding & collectives): they analyze every module of a lint run
-together (cross-module call graph), where everything above is
-per-module.
+``lint/failgraph.py`` (exception flow / ledger), ``lint/meshgraph.py``
+(sharding & collectives) and ``lint/rnggraph.py`` (RNG provenance &
+determinism — which also upgrades family 1 interprocedurally): they
+analyze every module of a lint run together (cross-module call graph),
+where everything above is per-module.
 
 Every rule is a function ``(ModuleContext) -> list[Finding]`` registered in
 ``RULES``. Rules are deliberately conservative: a finding should be either
@@ -910,6 +920,17 @@ def _mesh_rule(rule_id: str):
     return check
 
 
+def _rng_rule(rule_id: str):
+    """Same single-module fallback for the RNG-provenance families
+    (``lint/rnggraph.py``)."""
+    def check(ctx: ModuleContext) -> list[Finding]:
+        from d4pg_tpu.lint import rnggraph
+
+        return rnggraph.analyze([ctx], rules=[rule_id]).findings
+
+    return check
+
+
 RULES: dict[str, Rule] = {r.id: r for r in [
     Rule("prng-key-reuse",
          "same PRNG key consumed by two jax.random samplers without an "
@@ -1005,4 +1026,21 @@ RULES: dict[str, Rule] = {r.id: r for r in [
          "another argument or a live captured reference the call never "
          "rebinds — the replica deep-copy defect, statically",
          _mesh_rule("donation-alias"), scope="program"),
+    Rule("rng-ambient-stream",
+         "numpy module-level global draw, stdlib random.* draw, "
+         "unseeded default_rng()/RandomState(), or wall-clock-derived "
+         "seed reachable from determinism-scoped code (fleet/chaos/"
+         "traffic/sampler/ledger paths)",
+         _rng_rule("rng-ambient-stream"), scope="program"),
+    Rule("rng-stream-thread-escape",
+         "one Generator drawn from two distinct thread-spawn targets "
+         "without its own SeedSequence branch — declare "
+         "`# jaxlint: stream-owner=<Component.attr>` for caller-owned "
+         "branches",
+         _rng_rule("rng-stream-thread-escape"), scope="program"),
+    Rule("rng-draw-count-drift",
+         "seeded stream drawn a path-dependent count per event — the "
+         "PR-12 backpressure desync shape; clean only under the "
+         "documented skip-before-RNG-use idiom",
+         _rng_rule("rng-draw-count-drift"), scope="program"),
 ]}

@@ -1,7 +1,7 @@
 """Observability plane: wire-to-grad trace spans, the unified metrics
 registry, and the chaos flight recorder.
 
-Four stdlib-only modules (nothing here may import jax — the plane must
+Five stdlib-only modules (nothing here may import jax — the plane must
 be importable from the transport/locking layers that run before any
 backend exists):
 
@@ -25,6 +25,11 @@ backend exists):
 - ``obs.containment`` — the one-call crash-containment breadcrumb every
   thread role's top frame uses (``threads.contained_crashes`` counter +
   a flight event); jaxlint family 16 enforces its presence statically.
+- ``obs.draw_ledger`` — the runtime twin of the rnggraph determinism
+  pass (jaxlint families 22-24): per-stream RNG draw-call counts behind
+  a transparent Generator proxy, exported as a canonical digest the A/B
+  chaos drivers pin across arms ("equal seeded offered load" as an
+  oracle, not an argument).
 
 Lock discipline: every lock in this package is named ``_mu`` — a plain
 ``threading.Lock`` OUTSIDE the tiered hierarchy, deliberately terminal:
@@ -33,15 +38,17 @@ observability plane can be called from under any tiered lock without
 adding an edge the lock graph could cycle through.
 """
 
-from d4pg_tpu.obs import containment, flight, registry, trace
+from d4pg_tpu.obs import containment, draw_ledger, flight, registry, trace
 from d4pg_tpu.obs.containment import contained_crash
+from d4pg_tpu.obs.draw_ledger import LEDGER, DrawLedger
 from d4pg_tpu.obs.flight import FlightRecorder, record_event
 from d4pg_tpu.obs.registry import REGISTRY, MetricsRegistry
 from d4pg_tpu.obs.trace import DEFAULT_SAMPLE, TraceRecorder
 
 __all__ = [
-    "containment", "flight", "registry", "trace",
+    "containment", "draw_ledger", "flight", "registry", "trace",
     "FlightRecorder", "record_event", "contained_crash",
     "REGISTRY", "MetricsRegistry",
     "DEFAULT_SAMPLE", "TraceRecorder",
+    "LEDGER", "DrawLedger",
 ]

@@ -1,0 +1,151 @@
+"""DrawLedger: the runtime twin of jaxlint's rnggraph determinism pass.
+
+The static families (22-24) prove the *shape* of the RNG discipline —
+one SeedSequence branch per component, fixed draws per event, skip
+before the first draw.  The ledger proves the *execution*: it wraps
+component Generators in a counting proxy (or takes explicit
+``count()`` calls), accumulates draw-call counts per named stream, and
+exposes a canonical sha256 digest over the sorted ``stream=count``
+table.  Two runs that claim "equal seeded offered load" must produce
+the same digest for their schedule-class streams — the A/B chaos
+drivers (sampler, elastic) pin exactly that, turning the equal-load
+premise of every A/B gate from an argument into an oracle.
+
+Stream naming convention: ``schedule.*`` streams are drawn while
+materializing seeded schedules and models up front (kill schedules,
+TrafficModel construction) — config-deterministic, so their counts are
+comparable across arms and runs.  Everything else (``chaos.*`` per-
+actor event draws) is runtime-paced: counted and reported, but only
+the ``schedule.`` namespace participates in the A/B equality digest.
+
+Counting unit: one draw-method *call* (not array elements) — the same
+unit family 24's static interpreter reasons about, so a runtime count
+can be read against the lint stream table directly.
+
+House obs contract: stdlib-only (the proxy duck-types the Generator,
+so numpy never gets imported here), and the one lock is ``_mu`` — a
+terminal ``threading.Lock``: no path holding it acquires any other
+lock, so ``count()`` is safe from under any tiered lock.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+
+SCHEDULE_PREFIX = "schedule."
+
+# Generator draw surface the proxy intercepts (modern Generator plus
+# the legacy RandomState spellings); everything else delegates
+# untouched, so a wrapped stream is a drop-in Generator.
+_DRAW_METHODS = frozenset({
+    "beta", "binomial", "bytes", "chisquare", "choice", "dirichlet",
+    "exponential", "gamma", "geometric", "gumbel", "hypergeometric",
+    "integers", "laplace", "logistic", "lognormal", "logseries",
+    "multinomial", "multivariate_normal", "negative_binomial",
+    "noncentral_chisquare", "normal", "pareto", "permutation",
+    "permuted", "poisson", "power", "random", "rayleigh", "shuffle",
+    "standard_cauchy", "standard_exponential", "standard_gamma",
+    "standard_normal", "standard_t", "triangular", "uniform",
+    "vonmises", "wald", "weibull", "zipf",
+    "rand", "randn", "randint", "random_sample",
+})
+
+
+class _CountedStream:
+    """Duck-typed proxy over a Generator: draw methods count one call
+    into the ledger then delegate; every other attribute passes
+    through.  Never caches bound methods — the ledger's armed state is
+    consulted per call."""
+
+    __slots__ = ("_ledger", "_stream", "_rng")
+
+    def __init__(self, ledger: "DrawLedger", stream: str, rng) -> None:
+        self._ledger = ledger
+        self._stream = stream
+        self._rng = rng
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._rng, name)
+        if name in _DRAW_METHODS and callable(attr):
+            ledger, stream = self._ledger, self._stream
+            def counted(*args, **kwargs):
+                ledger.count(stream)
+                return attr(*args, **kwargs)
+            return counted
+        return attr
+
+
+class DrawLedger:
+    """Per-stream draw-call counts + canonical digest.
+
+    Instances default to armed (A/B drivers build one per arm); the
+    process-wide ``LEDGER`` starts disarmed and is armed by the fleet
+    harness at run start, so wrapped component streams cost one
+    attribute lookup and a bool check per draw outside chaos runs.
+    """
+
+    def __init__(self, armed: bool = True) -> None:
+        self._mu = threading.Lock()  # terminal: guards _counts only
+        self._armed = bool(armed)
+        self._counts: dict[str, int] = {}
+
+    # -- arming ------------------------------------------------------------
+    def arm(self) -> None:
+        self._armed = True
+
+    def disarm(self) -> None:
+        self._armed = False
+
+    def reset(self, armed: bool | None = None) -> None:
+        with self._mu:
+            self._counts.clear()
+        if armed is not None:
+            self._armed = bool(armed)
+
+    # -- counting ----------------------------------------------------------
+    def count(self, stream: str, n: int = 1) -> None:
+        """Record ``n`` draw calls against ``stream``; no-op unless
+        armed (the disarmed fast path takes no lock)."""
+        if not self._armed:
+            return
+        with self._mu:
+            self._counts[stream] = self._counts.get(stream, 0) + int(n)
+
+    def wrap(self, stream: str, rng):
+        """Wrap a Generator so its draw-method calls count against
+        ``stream``.  The proxy is transparent for everything else."""
+        return _CountedStream(self, stream, rng)
+
+    # -- export ------------------------------------------------------------
+    def counts(self) -> dict[str, int]:
+        with self._mu:
+            return dict(self._counts)
+
+    def digest(self, prefix: str = "") -> str:
+        """sha256 over the sorted ``stream=count`` lines whose stream
+        name starts with ``prefix`` — the canonical form, so equal
+        counted histories hash equal regardless of arrival order."""
+        snap = self.counts()
+        h = hashlib.sha256()
+        for name in sorted(snap):
+            if name.startswith(prefix):
+                h.update(f"{name}={snap[name]}\n".encode("ascii"))
+        return h.hexdigest()
+
+    def export(self) -> dict:
+        """The ``draw_ledger`` artifact block: per-stream counts, the
+        all-streams digest, and the schedule-namespace digest the A/B
+        drivers pin across arms."""
+        snap = self.counts()
+        return {
+            "streams": dict(sorted(snap.items())),
+            "total_draws": sum(snap.values()),
+            "digest": self.digest(),
+            "schedule_digest": self.digest(SCHEDULE_PREFIX),
+        }
+
+
+# Process-wide ledger (disarmed until a harness arms it), mirroring
+# obs.registry.REGISTRY / obs.flight.RECORDER.
+LEDGER = DrawLedger(armed=False)

@@ -314,8 +314,9 @@ class SampleDealer:
         self._beta = beta_schedule or SharedBetaSchedule()
         # Same default_rng construction as ReplayBuffer: seed the dealer
         # with the buffer's seed and its draws replay the exact stream a
-        # host sample_chunk loop over that buffer would consume.
-        self._rng = np.random.default_rng(seed)
+        # host sample_chunk loop over that buffer would consume — the
+        # stream's identity is owned by the buffer, not the dealer.
+        self._rng = np.random.default_rng(seed)  # jaxlint: stream-owner=ReplayBuffer._rng
         cap = self._trees.capacity
         self.max_priority = 1.0
         self._size = 0

@@ -308,6 +308,8 @@ def test_rule_catalog_covers_all_families():
         "thread-crash-containment", "span-terminal-missing",
         "ledger-conservation", "collective-axis-unbound",
         "sharding-spec-drift", "donation-alias",
+        "rng-ambient-stream", "rng-stream-thread-escape",
+        "rng-draw-count-drift",
     }
     assert RULES["sharding-rule-bypass"].scope == "module"
     # the lock-graph and wire-graph families analyze whole programs,
@@ -318,7 +320,9 @@ def test_rule_catalog_covers_all_families():
                  "unchecked-frame", "flag-bit-collision",
                  "thread-crash-containment", "span-terminal-missing",
                  "ledger-conservation", "collective-axis-unbound",
-                 "sharding-spec-drift", "donation-alias"):
+                 "sharding-spec-drift", "donation-alias",
+                 "rng-ambient-stream", "rng-stream-thread-escape",
+                 "rng-draw-count-drift"):
         assert RULES[rule].scope == "program"
     assert RULES["lock-order"].scope == "module"
 

@@ -52,14 +52,17 @@ def test_suppression_audit():
     contained-and-counted (status ``ok`` — a typo'd or weak handler
     vouches for nothing), an ``axis-bound-by`` must name a binder the
     sharding graph resolved AND verified bound under a shard_map axis
-    (status ``ok`` — same bar), and all must carry a justification
-    comment on the flagged line's neighborhood (the documented contract
-    — see docs/architecture.md "Suppressions"). New packages (e.g.
-    fleet/) ride the same audit automatically."""
+    (status ``ok`` — same bar), a ``stream-owner`` must name a stream
+    the rng graph discovered AND verified seeded or SeedSequence-
+    branched (status ``ok`` — same bar), and all must carry a
+    justification comment on the flagged line's neighborhood (the
+    documented contract — see docs/architecture.md "Suppressions").
+    New packages (e.g. fleet/) ride the same audit automatically."""
     import re
 
     from d4pg_tpu.lint.engine import (
         build_fail_graph, build_lock_graph, build_mesh_graph,
+        build_rng_graph,
     )
     from d4pg_tpu.lint.lockgraph import _DEFAULT_TIERS
     from d4pg_tpu.lint.rules import RULES
@@ -68,10 +71,13 @@ def test_suppression_audit():
     guarded = re.compile(r"#\s*jaxlint:\s*guarded-by=([\w,\- ]+)")
     contained = re.compile(r"#\s*jaxlint:\s*contained-by=([\w\.\-,]+)")
     bound = re.compile(r"#\s*jaxlint:\s*axis-bound-by=([\w\.\-,]+)")
+    stream_owner = re.compile(r"#\s*jaxlint:\s*stream-owner=([\w\.\-,]+)")
     graph, _errors = build_lock_graph([PACKAGE_DIR])
     known_locks = set(graph.nodes) | set(_DEFAULT_TIERS)
     fail_graph, _errors = build_fail_graph([PACKAGE_DIR])
     mesh_graph, _errors = build_mesh_graph([PACKAGE_DIR])
+    rng_graph, _errors = build_rng_graph(
+        [PACKAGE_DIR, os.path.join(REPO_ROOT, "bench.py")])
     audited = 0
     problems = []
     files = [os.path.join(REPO_ROOT, "bench.py")]
@@ -86,9 +92,11 @@ def test_suppression_audit():
             g = guarded.search(line)
             c = contained.search(line)
             b = bound.search(line)
+            s = stream_owner.search(line)
             # the lint package's own docs/fixtures mention the directives
             # in strings — only audit real trailing-comment annotations
-            if (m is None and g is None and c is None and b is None) \
+            if (m is None and g is None and c is None and b is None
+                    and s is None) \
                     or os.sep + "lint" + os.sep in path:
                 continue
             audited += 1
@@ -119,6 +127,15 @@ def test_suppression_audit():
                             f"with audit status "
                             f"{mesh_graph.handlers.get(spec)!r} (must "
                             f"resolve to a shard_map-bound frame)")
+            if s is not None:
+                for spec in s.group(1).split(","):
+                    if rng_graph.handlers.get(spec) != "ok":
+                        problems.append(
+                            f"{where}: stream-owner names stream {spec!r} "
+                            f"with audit status "
+                            f"{rng_graph.handlers.get(spec)!r} (must "
+                            f"resolve to a discovered seeded/branched "
+                            f"component stream)")
             lo, hi = max(0, i - 6), min(len(lines), i + 2)
             neighborhood = "".join(lines[lo:hi])
             # justification = at least one comment line near the
@@ -126,7 +143,7 @@ def test_suppression_audit():
             has_comment = any(
                 "#" in nl and not directive.search(nl)
                 and not guarded.search(nl) and not contained.search(nl)
-                and not bound.search(nl)
+                and not bound.search(nl) and not stream_owner.search(nl)
                 for nl in lines[lo:hi]) or '"""' in neighborhood
             if not has_comment:
                 problems.append(f"{where}: annotation without an adjacent "
@@ -337,6 +354,8 @@ def test_cli_json_modes_clean():
                  "handlers"},
         "mesh": {"functions", "modules", "axes", "shard_maps",
                  "collectives", "shardings", "donations", "handlers"},
+        "rng": {"functions", "modules", "scoped", "streams", "branches",
+                "handlers"},
     }
     for section, keys in sections.items():
         sub = doc[section]
